@@ -1,0 +1,57 @@
+"""Benchmark entry point — prints ONE JSON line.
+
+Current benchmark: MNIST-MLP training throughput on the real TPU chip
+(the reference's PR1 config, scripts/mnist_mlp_run.sh). This will be upgraded
+to the SpecInfer-vs-incremental-decoding tokens/s ratio (BASELINE.md north
+star) once the serving stack lands.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import flexflow_tpu as ff
+
+    batch = 512
+    config = ff.FFConfig(batch_size=batch, learning_rate=0.01)
+    model = ff.FFModel(config)
+    t = model.create_tensor([batch, 784], ff.DataType.DT_FLOAT)
+    x = model.dense(t, 512, ff.ActiMode.AC_MODE_RELU)
+    x = model.dense(x, 512, ff.ActiMode.AC_MODE_RELU)
+    x = model.dense(x, 10)
+    model.softmax(x)
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=0.01),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY])
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(batch, 784).astype(np.float32)
+    ys = rng.randint(0, 10, size=(batch, 1)).astype(np.int32)
+
+    # warmup (compile)
+    model.train_one_batch([xs], ys)
+    import jax
+
+    jax.block_until_ready(model.params)
+    iters = 30
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        model.train_one_batch([xs], ys)
+    jax.block_until_ready(model.params)
+    dt = time.perf_counter() - t0
+    samples_per_s = iters * batch / dt
+
+    print(json.dumps({
+        "metric": "mnist_mlp_train_throughput",
+        "value": round(samples_per_s, 1),
+        "unit": "samples/s",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""flexflow_tpu: a TPU-native distributed DL framework with FlexFlow's capabilities.
+
+Brand-new design on JAX/XLA/pjit/Pallas — not a port. The reference
+(jamestiotio/FlexFlow) informs WHAT exists (API surface, behavior, constants);
+the implementation is idiomatic TPU: SPMD over ``jax.sharding.Mesh``, functional
+transforms, static-shape serving, Pallas kernels for the hot paths.
+
+Public surface (mirrors the reference's Python API, see
+reference python/flexflow/core/flexflow_cffi.py):
+
+    import flexflow_tpu as ff
+    ffconfig = ff.FFConfig()
+    model = ff.FFModel(ffconfig)
+    t = model.create_tensor([batch, 784], ff.DataType.DT_FLOAT)
+    x = model.dense(t, 512, ff.ActiMode.AC_MODE_RELU)
+    ...
+    model.compile(optimizer=ff.SGDOptimizer(model, 0.01),
+                  loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[ff.MetricsType.METRICS_ACCURACY])
+    model.fit(x=..., y=..., epochs=1)
+"""
+
+from flexflow_tpu.ffconst import (
+    ActiMode,
+    AggrMode,
+    CompMode,
+    DataType,
+    InferenceMode,
+    LossType,
+    MetricsType,
+    OpType,
+    ParameterSyncType,
+    PoolType,
+    RequestType,
+)
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.core.tensor import Tensor
+from flexflow_tpu.core.model import FFModel
+from flexflow_tpu.core.initializer import (
+    ConstantInitializer,
+    GlorotUniformInitializer,
+    NormInitializer,
+    UniformInitializer,
+    ZeroInitializer,
+)
+from flexflow_tpu.training.optimizer import AdamOptimizer, SGDOptimizer
+from flexflow_tpu.training.dataloader import SingleDataLoader
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ActiMode",
+    "AdamOptimizer",
+    "AggrMode",
+    "CompMode",
+    "ConstantInitializer",
+    "DataType",
+    "FFConfig",
+    "FFModel",
+    "GlorotUniformInitializer",
+    "InferenceMode",
+    "LossType",
+    "MetricsType",
+    "NormInitializer",
+    "OpType",
+    "ParameterSyncType",
+    "PoolType",
+    "RequestType",
+    "SGDOptimizer",
+    "SingleDataLoader",
+    "Tensor",
+    "UniformInitializer",
+    "ZeroInitializer",
+]

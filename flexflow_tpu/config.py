@@ -1,0 +1,154 @@
+"""FFConfig: every runtime knob in one place.
+
+Capability-parity with the reference FFConfig (reference
+include/flexflow/config.h:102 and flag parsing src/runtime/model.cc:4082-4280):
+training hyperparams, cluster geometry, parallelism degrees, search knobs,
+fusion, offload, quantization, profiling. The Legion ``-ll:*`` resource flags
+have no TPU meaning; cluster geometry is expressed directly as a device mesh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional, Sequence
+
+
+@dataclasses.dataclass
+class FFConfig:
+    # --- training hyperparameters (reference config.h:120-125) ---
+    batch_size: int = 64
+    epochs: int = 1
+    learning_rate: float = 0.01
+    weight_decay: float = 0.0001
+    iterations: int = 1
+
+    # --- cluster geometry ---
+    # The reference counts nodes x workers(GPUs) x cpus; on TPU the unit is a
+    # chip in a mesh. num_devices=None -> len(jax.devices()).
+    num_nodes: int = 1
+    workers_per_node: Optional[int] = None
+    num_devices: Optional[int] = None
+
+    # --- parallelism degrees (reference config.h:156-159) ---
+    data_parallelism_degree: int = 1
+    tensor_parallelism_degree: int = 1
+    pipeline_parallelism_degree: int = 1
+    # new capability dimensions the reference lacks (SURVEY §2.3):
+    sequence_parallelism_degree: int = 1
+    expert_parallelism_degree: int = 1
+
+    # --- auto-parallelization search (reference config.h:131-143) ---
+    only_data_parallel: bool = False
+    search_budget: int = -1
+    search_alpha: float = 1.2
+    search_overlap_backward_update: bool = False
+    export_strategy_file: str = ""
+    include_costs_dot_graph: bool = False
+    substitution_json_path: Optional[str] = None
+    # memory-aware search (reference graph.cc:2126 lambda binary search)
+    mem_search_budget: int = -1
+
+    # --- execution ---
+    enable_fusion: bool = True          # XLA fuses; flag kept for parity/tests
+    computation_mode: str = "training"
+    seed: int = 0
+    # numerics: params kept in param_dtype, compute in compute_dtype
+    param_dtype: str = "float32"
+    compute_dtype: str = "float32"
+
+    # --- serving / offload / quantization (reference config.h:144-163) ---
+    cpu_offload: bool = False
+    offload_reserve_space_size: int = 8 * 1024 * 1024 * 1024
+    quantization_type: Optional[str] = None   # None | "int8" | "int4"
+    benchmarking: bool = False
+    inference_debugging: bool = False
+
+    # --- profiling / logging (reference config.h:127-130) ---
+    profiling: bool = False
+    perform_fusion_checks: bool = False
+    log_instance_creation: bool = False
+
+    # --- TPU specifics (no reference equivalent) ---
+    mesh_shape: Optional[Sequence[int]] = None   # overrides degree-derived mesh
+    mesh_axis_names: Sequence[str] = ("data", "model")
+    use_pallas: bool = True        # allow pure-jax fallback (CPU tests)
+    remat: bool = False            # jax.checkpoint the forward pass
+
+    def __post_init__(self):
+        if self.num_devices is None:
+            # Resolved lazily at compile time to avoid importing jax here.
+            pass
+
+    def resolve_num_devices(self) -> int:
+        if self.num_devices is not None:
+            return self.num_devices
+        import jax
+
+        return len(jax.devices())
+
+    @property
+    def total_parallelism_degree(self) -> int:
+        return (
+            self.data_parallelism_degree
+            * self.tensor_parallelism_degree
+            * self.pipeline_parallelism_degree
+            * self.sequence_parallelism_degree
+        )
+
+    # ------------------------------------------------------------------
+    # Flag parsing — same spirit as FFConfig::parse_args (model.cc:4082).
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_args(cls, argv: Optional[Sequence[str]] = None) -> "FFConfig":
+        p = argparse.ArgumentParser(add_help=False, allow_abbrev=False)
+        p.add_argument("-b", "--batch-size", type=int, default=64)
+        p.add_argument("-e", "--epochs", type=int, default=1)
+        p.add_argument("--lr", "--learning-rate", dest="learning_rate",
+                       type=float, default=0.01)
+        p.add_argument("--wd", "--weight-decay", dest="weight_decay",
+                       type=float, default=0.0001)
+        p.add_argument("-ll:gpu", "--devices", dest="num_devices", type=int,
+                       default=None)
+        p.add_argument("--nodes", type=int, default=1)
+        p.add_argument("-dp", "--data-parallelism-degree", type=int, default=1)
+        p.add_argument("-tp", "--tensor-parallelism-degree", type=int, default=1)
+        p.add_argument("-pp", "--pipeline-parallelism-degree", type=int, default=1)
+        p.add_argument("-sp", "--sequence-parallelism-degree", type=int, default=1)
+        p.add_argument("--only-data-parallel", action="store_true")
+        p.add_argument("--budget", "--search-budget", dest="search_budget",
+                       type=int, default=-1)
+        p.add_argument("--alpha", "--search-alpha", dest="search_alpha",
+                       type=float, default=1.2)
+        p.add_argument("--fusion", dest="enable_fusion", action="store_true",
+                       default=True)
+        p.add_argument("--no-fusion", dest="enable_fusion", action="store_false")
+        p.add_argument("--profiling", action="store_true")
+        p.add_argument("--offload", dest="cpu_offload", action="store_true")
+        p.add_argument("--4bit-quantization", dest="q4", action="store_true")
+        p.add_argument("--8bit-quantization", dest="q8", action="store_true")
+        p.add_argument("--inference-debugging", action="store_true")
+        p.add_argument("--seed", type=int, default=0)
+        args, _unknown = p.parse_known_args(argv)
+        quant = "int4" if args.q4 else ("int8" if args.q8 else None)
+        return cls(
+            batch_size=args.batch_size,
+            epochs=args.epochs,
+            learning_rate=args.learning_rate,
+            weight_decay=args.weight_decay,
+            num_devices=args.num_devices,
+            num_nodes=args.nodes,
+            data_parallelism_degree=args.data_parallelism_degree,
+            tensor_parallelism_degree=args.tensor_parallelism_degree,
+            pipeline_parallelism_degree=args.pipeline_parallelism_degree,
+            sequence_parallelism_degree=args.sequence_parallelism_degree,
+            only_data_parallel=args.only_data_parallel,
+            search_budget=args.search_budget,
+            search_alpha=args.search_alpha,
+            enable_fusion=args.enable_fusion,
+            profiling=args.profiling,
+            cpu_offload=args.cpu_offload,
+            quantization_type=quant,
+            inference_debugging=args.inference_debugging,
+            seed=args.seed,
+        )

@@ -1,0 +1,72 @@
+"""Parameter initializers.
+
+Same set as the reference (reference src/runtime/initializer.cc:349 +
+initializer_kernel.cu): Glorot-uniform, zero, constant, uniform, normal — as
+pure functions of a jax PRNG key instead of curand Legion tasks.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+class Initializer:
+    def __call__(self, key, shape, dtype):
+        raise NotImplementedError
+
+
+class GlorotUniformInitializer(Initializer):
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+
+    def __call__(self, key, shape, dtype):
+        if len(shape) >= 2:
+            fan_in, fan_out = shape[-2], shape[-1]
+        else:
+            fan_in = fan_out = shape[0] if shape else 1
+        limit = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+class ZeroInitializer(Initializer):
+    def __call__(self, key, shape, dtype):
+        return jnp.zeros(shape, dtype)
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value: float):
+        self.value = value
+
+    def __call__(self, key, shape, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, seed: int = 0, min_value: float = 0.0, max_value: float = 1.0):
+        self.seed = seed
+        self.min_value = min_value
+        self.max_value = max_value
+
+    def __call__(self, key, shape, dtype):
+        return jax.random.uniform(key, shape, dtype, self.min_value, self.max_value)
+
+
+class NormInitializer(Initializer):
+    def __init__(self, seed: int = 0, mean: float = 0.0, stddev: float = 1.0):
+        self.seed = seed
+        self.mean = mean
+        self.stddev = stddev
+
+    def __call__(self, key, shape, dtype):
+        return self.mean + self.stddev * jax.random.normal(key, shape, dtype)
+
+
+def default_kernel_initializer() -> Initializer:
+    return GlorotUniformInitializer()
+
+
+def default_bias_initializer() -> Initializer:
+    return ZeroInitializer()

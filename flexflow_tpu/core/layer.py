@@ -1,0 +1,64 @@
+"""Layer: one node in the build-time graph.
+
+Equivalent role to the reference's ``Layer`` (reference
+include/flexflow/layer.h:10, src/runtime/layer.cc): records op type, inputs,
+and attrs as the user calls builder methods on FFModel. At compile these lower
+1:1 onto op implementations (the reference lowers Layer->Op in
+``create_operators_from_layers``, src/runtime/model.cc:3229; here the "Op" is a
+pure-jax/Pallas forward function plus sharding rules from the op registry).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from flexflow_tpu.ffconst import DataType, OpType
+
+
+@dataclasses.dataclass
+class WeightSpec:
+    """One learnable parameter of a layer."""
+
+    name: str                      # e.g. "kernel", "bias"
+    shape: Tuple[int, ...]
+    dtype: DataType
+    initializer: Any = None        # Initializer or None -> op default
+    # Sharding hint resolved at compile time, e.g. ("model", None) axis names
+    sharding_dims: Optional[Tuple[Optional[str], ...]] = None
+
+
+class Layer:
+    # Fallback counter for layers created without a model-owned namespace;
+    # FFModel passes its own dict so names are unique per model, not global.
+    _counts: Dict[str, int] = {}
+
+    def __init__(
+        self,
+        op_type: OpType,
+        name: Optional[str],
+        inputs: List["Tensor"],
+        attrs: Dict[str, Any],
+        counts: Optional[Dict[str, int]] = None,
+    ):
+        counts = counts if counts is not None else Layer._counts
+        base = name or op_type.name.lower()
+        n = counts.get(base, 0)
+        counts[base] = n + 1
+        self.name = base if n == 0 else f"{base}_{n}"
+        self.op_type = op_type
+        self.inputs = list(inputs)
+        self.attrs = dict(attrs)
+        self.outputs: List["Tensor"] = []
+        self.weights: List[WeightSpec] = []
+        # serving: transformer layer index for pipeline-stage placement
+        # (reference inference_manager.cc:131 uses layer_id/layers_per_stage)
+        self.transformer_layer_id: int = attrs.get("transformer_layer_id", 0)
+
+    def __repr__(self):
+        return (f"Layer({self.name}, {self.op_type.name}, "
+                f"in={[t.name for t in self.inputs]})")
+
+    @classmethod
+    def reset_naming(cls):
+        cls._counts = {}

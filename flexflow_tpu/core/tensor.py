@@ -1,0 +1,81 @@
+"""Graph-build tensor handle.
+
+Equivalent role to the reference's ``TensorBase`` (reference
+include/flexflow/tensor.h:29): a plain shape+dtype handle recorded by the
+op-builder API. Sharded/materialized state (the reference's ``ParallelTensor``,
+include/flexflow/parallel_tensor.h:134) lives in jax arrays with
+``NamedSharding`` after compile; this class only carries graph metadata plus,
+for parameters, accessors into the compiled model's param store.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Optional, Tuple
+
+import numpy as np
+
+from flexflow_tpu.ffconst import DataType
+
+if TYPE_CHECKING:
+    from flexflow_tpu.core.layer import Layer
+    from flexflow_tpu.core.model import FFModel
+
+
+class Tensor:
+    _next_id = 0
+
+    def __init__(
+        self,
+        dims: Tuple[int, ...],
+        dtype: DataType,
+        name: str = "",
+        owner_layer: Optional["Layer"] = None,
+        owner_idx: int = 0,
+        model: Optional["FFModel"] = None,
+        is_weight: bool = False,
+        weight_name: Optional[str] = None,
+    ):
+        self.tensor_id = Tensor._next_id
+        Tensor._next_id += 1
+        self.dims: Tuple[int, ...] = tuple(int(d) for d in dims)
+        self.dtype = dtype
+        self.name = name or f"tensor_{self.tensor_id}"
+        self.owner_layer = owner_layer
+        self.owner_idx = owner_idx
+        self.model = model
+        self.is_weight = is_weight
+        self.weight_name = weight_name  # (layer_name, param_name) key when weight
+
+    @property
+    def num_dims(self) -> int:
+        return len(self.dims)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self.dims
+
+    def __repr__(self):
+        return f"Tensor({self.name}, dims={self.dims}, dtype={self.dtype.name})"
+
+    # -- parameter access (reference flexflow_cffi.py:1202-1229 get/set_weights)
+    def get_weights(self, ffmodel: Optional["FFModel"] = None) -> np.ndarray:
+        model = ffmodel or self.model
+        if model is None or not self.is_weight:
+            raise ValueError(f"{self} is not a parameter tensor")
+        return model.get_parameter_by_key(self.weight_name)
+
+    def set_weights(self, ffmodel_or_array, array: Optional[np.ndarray] = None):
+        if array is None:
+            model, array = self.model, ffmodel_or_array
+        else:
+            model = ffmodel_or_array
+        if model is None or not self.is_weight:
+            raise ValueError(f"{self} is not a parameter tensor")
+        model.set_parameter_by_key(self.weight_name, np.asarray(array))
+
+    # numpy-style convenience
+    def get_tensor(self, ffmodel=None):
+        return self.get_weights(ffmodel)
+
+    def set_tensor(self, ffmodel_or_array, array=None):
+        return self.set_weights(ffmodel_or_array, array)

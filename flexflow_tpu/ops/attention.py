@@ -1,0 +1,124 @@
+"""Training multi-head attention (reference src/ops/attention.cc, 1,036 LoC,
+cuDNN multi-head attention API).
+
+Serving attention (incremental / speculative / tree-verify with KV caches) is
+a separate family in flexflow_tpu/serve/attention_ops.py, mirroring the
+reference's split between attention.cc and {inc,spec_inc,tree_inc}_multihead_
+self_attention.cc.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.core.layer import WeightSpec
+from flexflow_tpu.core.initializer import default_kernel_initializer
+from flexflow_tpu.ffconst import DataType, OpType
+from flexflow_tpu.ops.base import OpImpl, register_op
+
+
+def mha_forward(q, k, v, params, num_heads, dropout=0.0, causal=False,
+                rng=None, training=False, add_zero_attn=False):
+    """q,k,v: [batch, seq, embed]. Weights: wq/wk/wv [embed, num_heads*head_dim],
+    wo [num_heads*head_dim, embed]; optional biases bq/bk/bv/bo and learnable
+    appended bias_k/bias_v rows (torch MultiheadAttention semantics)."""
+    b, sq, _ = q.shape
+    sk = k.shape[1]
+    wq, wk, wv, wo = params["wq"], params["wk"], params["wv"], params["wo"]
+    head_dim = wq.shape[1] // num_heads
+    qp, kp, vp = q @ wq, k @ wk, v @ wv
+    if "bq" in params:
+        qp, kp, vp = qp + params["bq"], kp + params["bk"], vp + params["bv"]
+    if "bias_k" in params:  # add_bias_kv: append one learnable k/v position
+        kp = jnp.concatenate([kp, jnp.broadcast_to(params["bias_k"],
+                                                   (b, 1, kp.shape[-1]))], axis=1)
+        vp = jnp.concatenate([vp, jnp.broadcast_to(params["bias_v"],
+                                                   (b, 1, vp.shape[-1]))], axis=1)
+        sk += 1
+    if add_zero_attn:
+        kp = jnp.concatenate([kp, jnp.zeros((b, 1, kp.shape[-1]), kp.dtype)],
+                             axis=1)
+        vp = jnp.concatenate([vp, jnp.zeros((b, 1, vp.shape[-1]), vp.dtype)],
+                             axis=1)
+        sk += 1
+    qh = qp.reshape(b, sq, num_heads, head_dim)
+    kh = kp.reshape(b, sk, num_heads, head_dim)
+    vh = vp.reshape(b, sk, num_heads, head_dim)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qh, kh,
+                        preferred_element_type=jnp.float32)
+    scores = scores / math.sqrt(head_dim)
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if training and dropout > 0.0 and rng is not None:
+        keep = 1.0 - dropout
+        probs = probs * jax.random.bernoulli(rng, keep, probs.shape) / keep
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vh)
+    out = out.reshape(b, sq, num_heads * head_dim) @ wo
+    if "bo" in params:
+        out = out + params["bo"]
+    return out
+
+
+@register_op
+class MultiHeadAttention(OpImpl):
+    op_type = OpType.MULTIHEAD_ATTENTION
+
+    @staticmethod
+    def infer_output_specs(attrs, input_specs):
+        (sq, d) = input_specs[0]
+        return [(tuple(sq[:-1]) + (attrs["embed_dim"],), d)]
+
+    @staticmethod
+    def weight_specs(attrs, input_specs):
+        (sq, d) = input_specs[0]
+        (sk, _dk) = input_specs[1]
+        (sv, _dv) = input_specs[2]
+        embed = attrs["embed_dim"]
+        nh = attrs["num_heads"]
+        kdim = attrs.get("kdim") or embed
+        vdim = attrs.get("vdim") or embed
+        proj = nh * (kdim // nh)
+        init = attrs.get("kernel_initializer") or default_kernel_initializer()
+        vproj = nh * (vdim // nh)
+        specs = [
+            WeightSpec("wq", (sq[-1], proj), d, init, sharding_dims=(None, "model")),
+            WeightSpec("wk", (sk[-1], proj), d, init, sharding_dims=(None, "model")),
+            WeightSpec("wv", (sv[-1], vproj), d, init,
+                       sharding_dims=(None, "model")),
+            WeightSpec("wo", (vproj, embed), d, init,
+                       sharding_dims=("model", None)),
+        ]
+        if attrs.get("bias", True):
+            from flexflow_tpu.core.initializer import ZeroInitializer
+
+            zero = ZeroInitializer()
+            specs += [
+                WeightSpec("bq", (proj,), d, zero, sharding_dims=("model",)),
+                WeightSpec("bk", (proj,), d, zero, sharding_dims=("model",)),
+                WeightSpec("bv", (vproj,), d, zero, sharding_dims=("model",)),
+                WeightSpec("bo", (embed,), d, zero),
+            ]
+        if attrs.get("add_bias_kv", False):
+            specs += [
+                WeightSpec("bias_k", (1, proj), d, init),
+                WeightSpec("bias_v", (1, vproj), d, init),
+            ]
+        return specs
+
+    @staticmethod
+    def forward(attrs, params, inputs, ctx):
+        q, k, v = inputs[0], inputs[1], inputs[2]
+        out = mha_forward(
+            q, k, v, params, attrs["num_heads"],
+            dropout=attrs.get("dropout", 0.0),
+            causal=attrs.get("causal", False),
+            rng=ctx.layer_rng(),
+            training=ctx.training,
+            add_zero_attn=attrs.get("add_zero_attn", False),
+        )
+        return [out]

@@ -1,0 +1,100 @@
+"""Op registry + execution context.
+
+The reference implements each operator as a C++ class with Legion task
+launchers and CUDA kernel wrappers (reference src/ops/*, pattern described in
+SURVEY §2.2). Here an op is three static pieces of metadata + a pure function:
+
+* ``infer_output_specs`` — shape/dtype inference (the reference computes output
+  ``ParallelTensorShape`` via dim-mapping records in each op ctor).
+* ``weight_specs``       — learnable parameters (reference per-op weight regions).
+* ``forward``            — pure jax/Pallas computation. Under ``jax.jit`` XLA
+  fuses and schedules; there is no per-op task launch to optimize away (the
+  reference needs an explicit FusedOp container for that, src/ops/fused.cc).
+
+Serving ops additionally read/write named state (KV caches) through the
+context's ``state_in``/``state_out`` dicts, which the compiled step function
+threads functionally.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple, Type
+
+from flexflow_tpu.ffconst import DataType, OpType
+
+TensorSpec = Tuple[Tuple[int, ...], DataType]
+
+
+def stable_hash(*parts) -> int:
+    """Deterministic across processes/hosts (Python's hash() is salted —
+    multi-host SPMD must fold identical constants everywhere)."""
+    import zlib
+
+    return zlib.crc32("\x1f".join(str(p) for p in parts).encode()) & 0x7FFFFFFF
+
+
+@dataclasses.dataclass
+class OpContext:
+    """Per-call execution context threaded through op forwards."""
+
+    training: bool = False
+    rng: Any = None                      # jax PRNG key or None
+    layer_name: str = ""
+    compute_dtype: Any = None            # jnp dtype for activations
+    batch_config: Any = None             # serving BatchConfig pytree
+    state_in: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    state_out: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    mesh: Any = None
+    config: Any = None                   # FFConfig
+    extra_outputs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def layer_rng(self, salt: int = 0):
+        import jax
+
+        if self.rng is None:
+            return None
+        return jax.random.fold_in(self.rng, stable_hash(self.layer_name, salt))
+
+
+class OpImpl:
+    op_type: OpType = None
+
+    @staticmethod
+    def infer_output_specs(attrs: Dict[str, Any],
+                           input_specs: List[TensorSpec]) -> List[TensorSpec]:
+        raise NotImplementedError
+
+    @staticmethod
+    def weight_specs(attrs: Dict[str, Any],
+                     input_specs: List[TensorSpec]) -> List:
+        return []
+
+    @staticmethod
+    def forward(attrs: Dict[str, Any], params: Dict[str, Any],
+                inputs: List[Any], ctx: OpContext) -> List[Any]:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[OpType, Type[OpImpl]] = {}
+
+
+def register_op(cls: Type[OpImpl]) -> Type[OpImpl]:
+    assert cls.op_type is not None, cls
+    _REGISTRY[cls.op_type] = cls
+    return cls
+
+
+def register_op_as(*op_types: OpType):
+    def deco(cls):
+        for t in op_types:
+            _REGISTRY[t] = cls
+        return cls
+
+    return deco
+
+
+def get_op_impl(op_type: OpType) -> Type[OpImpl]:
+    if op_type not in _REGISTRY:
+        raise NotImplementedError(f"No implementation registered for {op_type}")
+    return _REGISTRY[op_type]

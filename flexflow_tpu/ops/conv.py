@@ -1,0 +1,104 @@
+"""Conv2D and Pool2D operators (NCHW, matching the reference's layout).
+
+Capability parity with reference src/ops/conv_2d.cc (1,204, cuDNN conv + algo
+search) and pool_2d.cc (690). On TPU, convolution lowers to XLA
+conv_general_dilated which tiles onto the MXU; there is no algorithm search to
+run — XLA picks the layout.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.core.layer import WeightSpec
+from flexflow_tpu.core.initializer import (
+    default_bias_initializer,
+    default_kernel_initializer,
+)
+from flexflow_tpu.ffconst import ActiMode, OpType, PoolType
+from flexflow_tpu.ops.base import OpImpl, register_op
+from flexflow_tpu.ops.linear import apply_activation
+
+
+def _conv_out(size, kernel, stride, pad):
+    return (size + 2 * pad - kernel) // stride + 1
+
+
+@register_op
+class Conv2D(OpImpl):
+    op_type = OpType.CONV2D
+
+    @staticmethod
+    def infer_output_specs(attrs, input_specs):
+        (s, d) = input_specs[0]
+        n, c, h, w = s
+        oh = _conv_out(h, attrs["kernel_h"], attrs["stride_h"], attrs["padding_h"])
+        ow = _conv_out(w, attrs["kernel_w"], attrs["stride_w"], attrs["padding_w"])
+        return [((n, attrs["out_channels"], oh, ow), d)]
+
+    @staticmethod
+    def weight_specs(attrs, input_specs):
+        (s, d) = input_specs[0]
+        c = s[1]
+        groups = attrs.get("groups", 1)
+        specs = [
+            WeightSpec("kernel",
+                       (attrs["out_channels"], c // groups,
+                        attrs["kernel_h"], attrs["kernel_w"]), d,
+                       attrs.get("kernel_initializer")
+                       or default_kernel_initializer()),
+        ]
+        if attrs.get("use_bias", True):
+            specs.append(WeightSpec("bias", (attrs["out_channels"],), d,
+                                    attrs.get("bias_initializer")
+                                    or default_bias_initializer()))
+        return specs
+
+    @staticmethod
+    def forward(attrs, params, inputs, ctx):
+        x = inputs[0]
+        y = jax.lax.conv_general_dilated(
+            x, params["kernel"],
+            window_strides=(attrs["stride_h"], attrs["stride_w"]),
+            padding=[(attrs["padding_h"], attrs["padding_h"]),
+                     (attrs["padding_w"], attrs["padding_w"])],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=attrs.get("groups", 1),
+            preferred_element_type=jnp.float32,
+        ).astype(x.dtype)
+        if attrs.get("use_bias", True):
+            y = y + params["bias"].reshape(1, -1, 1, 1)
+        return [apply_activation(y, attrs.get("activation", ActiMode.AC_MODE_NONE))]
+
+
+@register_op
+class Pool2D(OpImpl):
+    op_type = OpType.POOL2D
+
+    @staticmethod
+    def infer_output_specs(attrs, input_specs):
+        (s, d) = input_specs[0]
+        n, c, h, w = s
+        oh = _conv_out(h, attrs["kernel_h"], attrs["stride_h"], attrs["padding_h"])
+        ow = _conv_out(w, attrs["kernel_w"], attrs["stride_w"], attrs["padding_w"])
+        return [((n, c, oh, ow), d)]
+
+    @staticmethod
+    def forward(attrs, params, inputs, ctx):
+        x = inputs[0]
+        window = (1, 1, attrs["kernel_h"], attrs["kernel_w"])
+        strides = (1, 1, attrs["stride_h"], attrs["stride_w"])
+        padding = ((0, 0), (0, 0),
+                   (attrs["padding_h"], attrs["padding_h"]),
+                   (attrs["padding_w"], attrs["padding_w"]))
+        ptype = attrs.get("pool_type", PoolType.POOL_MAX)
+        if ptype == PoolType.POOL_MAX:
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+            y = jax.lax.reduce_window(x, init, jax.lax.max, window, strides, padding)
+        else:
+            ones = jnp.ones_like(x)
+            s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, padding)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, padding)
+            y = s / cnt
+        return [apply_activation(y, attrs.get("activation", ActiMode.AC_MODE_NONE))]

@@ -1,0 +1,33 @@
+"""Dropout operator (reference src/ops/dropout.cc, cuDNN dropout).
+
+Uses the context PRNG key folded with the layer name; identity when not
+training.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.ffconst import OpType
+from flexflow_tpu.ops.base import OpImpl, register_op
+
+
+@register_op
+class Dropout(OpImpl):
+    op_type = OpType.DROPOUT
+
+    @staticmethod
+    def infer_output_specs(attrs, input_specs):
+        return [input_specs[0]]
+
+    @staticmethod
+    def forward(attrs, params, inputs, ctx):
+        x = inputs[0]
+        rate = attrs.get("rate", 0.5)
+        if not ctx.training or rate == 0.0 or ctx.rng is None:
+            return [x]
+        key = ctx.layer_rng()
+        keep = 1.0 - rate
+        mask = jax.random.bernoulli(key, keep, x.shape)
+        return [jnp.where(mask, x / keep, 0.0).astype(x.dtype)]

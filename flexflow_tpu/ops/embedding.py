@@ -1,0 +1,51 @@
+"""Embedding operator.
+
+Capability parity with reference src/ops/embedding.cc (1,232) +
+kernels/embedding_kernels.cu: aggregation modes NONE/SUM/AVG; weight can be
+sharded on the vocab axis (reference: "weight sharded on vocab or replica") —
+here expressed by the WeightSpec sharding hint.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from flexflow_tpu.core.layer import WeightSpec
+from flexflow_tpu.core.initializer import NormInitializer
+from flexflow_tpu.ffconst import AggrMode, DataType, OpType
+from flexflow_tpu.ops.base import OpImpl, register_op
+
+
+@register_op
+class Embedding(OpImpl):
+    op_type = OpType.EMBEDDING
+
+    @staticmethod
+    def infer_output_specs(attrs, input_specs):
+        (shape, _dtype) = input_specs[0]
+        out_dim = attrs["out_dim"]
+        dtype = attrs.get("data_type", DataType.DT_FLOAT)
+        aggr = attrs.get("aggr", AggrMode.AGGR_MODE_NONE)
+        if aggr == AggrMode.AGGR_MODE_NONE:
+            return [(tuple(shape) + (out_dim,), dtype)]
+        # SUM/AVG reduce over the last (bag) dim
+        return [(tuple(shape[:-1]) + (out_dim,), dtype)]
+
+    @staticmethod
+    def weight_specs(attrs, input_specs):
+        dtype = attrs.get("data_type", DataType.DT_FLOAT)
+        init = attrs.get("kernel_initializer") or NormInitializer(stddev=0.02)
+        return [WeightSpec("weight", (attrs["num_entries"], attrs["out_dim"]),
+                           dtype, init, sharding_dims=(None, "model"))]
+
+    @staticmethod
+    def forward(attrs, params, inputs, ctx):
+        ids = inputs[0].astype(jnp.int32)
+        table = params["weight"]
+        out = jnp.take(table, ids, axis=0)
+        aggr = attrs.get("aggr", AggrMode.AGGR_MODE_NONE)
+        if aggr == AggrMode.AGGR_MODE_SUM:
+            out = jnp.sum(out, axis=-2)
+        elif aggr == AggrMode.AGGR_MODE_AVG:
+            out = jnp.mean(out, axis=-2)
+        return [out]

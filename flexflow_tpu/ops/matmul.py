@@ -1,0 +1,26 @@
+"""BatchMatmul operator (reference src/ops/batch_matmul.cc, 714 LoC:
+strided batched gemm via cublas)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from flexflow_tpu.ffconst import OpType
+from flexflow_tpu.ops.base import OpImpl, register_op
+
+
+@register_op
+class BatchMatmul(OpImpl):
+    op_type = OpType.BATCH_MATMUL
+
+    @staticmethod
+    def infer_output_specs(attrs, input_specs):
+        (sa, da), (sb, _db) = input_specs
+        assert sa[:-2] == sb[:-2], (sa, sb)
+        assert sa[-1] == sb[-2], (sa, sb)
+        return [(tuple(sa[:-1]) + (sb[-1],), da)]
+
+    @staticmethod
+    def forward(attrs, params, inputs, ctx):
+        a, b = inputs
+        return [jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(a.dtype)]

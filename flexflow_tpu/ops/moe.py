@@ -1,0 +1,160 @@
+"""Mixture-of-Experts operators: GroupBy, Aggregate, AggregateSpec, Experts.
+
+Capability parity with reference src/ops/{group_by,aggregate,aggregate_spec,
+experts}.cc. The reference routes tokens through CUDA scatter/gather buckets;
+the TPU-idiomatic formulation is dense one-hot dispatch/combine einsums
+(GShard-style), which keep shapes static for XLA and put the FLOPs on the MXU.
+Expert parallelism shards the expert axis over the mesh "expert" axis
+(see flexflow_tpu/parallel).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.core.layer import WeightSpec
+from flexflow_tpu.core.initializer import default_kernel_initializer
+from flexflow_tpu.ffconst import ActiMode, DataType, OpType
+from flexflow_tpu.ops.base import OpImpl, register_op
+from flexflow_tpu.ops.linear import apply_activation
+
+
+def make_dispatch(assign, n_experts, capacity):
+    """assign: [tokens, k] int expert ids -> dispatch one-hot
+    [tokens, n_experts, capacity] respecting per-expert capacity (first-come)."""
+    tokens, k = assign.shape
+    onehot = jax.nn.one_hot(assign, n_experts, dtype=jnp.int32)  # [T,k,E]
+    # position of each (token, slot) within its expert queue
+    flat = onehot.reshape(tokens * k, n_experts)
+    pos = jnp.cumsum(flat, axis=0) - flat  # [T*k, E]
+    pos = pos.reshape(tokens, k, n_experts)
+    in_cap = pos < capacity
+    disp = (onehot * in_cap).astype(jnp.float32)  # [T,k,E]
+    pos_capped = jnp.clip(pos, 0, capacity - 1)
+    pos_onehot = jax.nn.one_hot(pos_capped, capacity, dtype=jnp.float32)  # [T,k,E,C]
+    # [T, k, E, C]: 1 where token t's slot j goes to expert e position c
+    return disp[..., None] * pos_onehot
+
+
+@register_op
+class GroupBy(OpImpl):
+    """Route tokens into per-expert buckets (reference src/ops/group_by.cc).
+
+    Inputs: data [tokens, d], assign [tokens, k] (top-k expert indices).
+    Outputs: n_experts tensors of [capacity, d] (zero-padded).
+    """
+
+    op_type = OpType.GROUP_BY
+
+    @staticmethod
+    def _capacity(attrs, tokens):
+        k = attrs["k"]
+        n = attrs["n"]
+        factor = attrs.get("alpha", 1.0)
+        cap = int(max(1, factor * k * tokens / n))
+        return cap
+
+    @staticmethod
+    def infer_output_specs(attrs, input_specs):
+        (sd, dd) = input_specs[0]
+        tokens = sd[0]
+        cap = GroupBy._capacity(attrs, tokens)
+        return [((cap,) + tuple(sd[1:]), dd) for _ in range(attrs["n"])]
+
+    @staticmethod
+    def forward(attrs, params, inputs, ctx):
+        data, assign = inputs[0], inputs[1].astype(jnp.int32)
+        n, cap = attrs["n"], GroupBy._capacity(attrs, data.shape[0])
+        disp = make_dispatch(assign, n, cap)  # [T,k,E,C]
+        buckets = jnp.einsum("tkec,td->ecd", disp, data)
+        return [buckets[e] for e in range(n)]
+
+
+@register_op
+class Aggregate(OpImpl):
+    """Weighted combine of expert outputs back to token order
+    (reference src/ops/aggregate.cc).
+
+    Inputs: gate_preds [tokens, k], gate_assign [tokens, k],
+    then n expert outputs [capacity, d]. Output: [tokens, d].
+    """
+
+    op_type = OpType.AGGREGATE
+
+    @staticmethod
+    def infer_output_specs(attrs, input_specs):
+        (sg, _dg) = input_specs[0]
+        (se, de) = input_specs[2]
+        return [((sg[0],) + tuple(se[1:]), de)]
+
+    @staticmethod
+    def forward(attrs, params, inputs, ctx):
+        gate_preds, gate_assign = inputs[0], inputs[1].astype(jnp.int32)
+        experts = jnp.stack(inputs[2:], axis=0)  # [E, C, d]
+        n, cap = experts.shape[0], experts.shape[1]
+        disp = make_dispatch(gate_assign, n, cap)  # [T,k,E,C]
+        combine = disp * gate_preds[..., None, None]
+        out = jnp.einsum("tkec,ecd->td", combine, experts)
+        return [out]
+
+
+@register_op
+class AggregateSpec(OpImpl):
+    """Training-label variant of Aggregate (reference aggregate_spec.cc) —
+    combines with the *true* gate assignment for auxiliary loss computation."""
+
+    op_type = OpType.AGG_SPEC
+
+    infer_output_specs = Aggregate.infer_output_specs
+    forward = Aggregate.forward
+
+
+@register_op
+class Experts(OpImpl):
+    """Fused MoE expert FFN batch for inference (reference src/ops/experts.cc
+    1,176 / experts.cu 1,447: group tokens by expert, batched gemms).
+
+    Inputs: x [tokens, d], indices [tokens, k], gate weights [tokens, k].
+    Computes a one-layer expert FFN per expert and combines top-k outputs.
+    """
+
+    op_type = OpType.EXPERTS
+
+    @staticmethod
+    def infer_output_specs(attrs, input_specs):
+        (sx, dx) = input_specs[0]
+        return [((sx[0], attrs["experts_output_dim_size"]), dx)]
+
+    @staticmethod
+    def weight_specs(attrs, input_specs):
+        (sx, dx) = input_specs[0]
+        n = attrs["num_experts"]
+        d_in = attrs.get("experts_internal_dim_size", sx[-1])
+        d_out = attrs["experts_output_dim_size"]
+        init = attrs.get("kernel_initializer") or default_kernel_initializer()
+        specs = [WeightSpec("kernel", (n, sx[-1], d_out), dx, init,
+                            sharding_dims=("expert", None, None))]
+        if attrs.get("use_bias", False):
+            from flexflow_tpu.core.initializer import ZeroInitializer
+
+            specs.append(WeightSpec("bias", (n, d_out), dx, ZeroInitializer(),
+                                    sharding_dims=("expert", None)))
+        return specs
+
+    @staticmethod
+    def forward(attrs, params, inputs, ctx):
+        x, idx, gates = inputs[0], inputs[1].astype(jnp.int32), inputs[2]
+        n = attrs["num_experts"]
+        start = attrs.get("experts_start_idx", 0)
+        local = idx - start
+        onehot = jax.nn.one_hot(local, n, dtype=x.dtype)  # [T,k,E]
+        weighted = jnp.einsum("tke,tk->te", onehot, gates)  # [T,E]
+        # y_t = sum_e w_te * (x_t @ W_e)  — dense dispatch, MXU-friendly
+        per_expert = jnp.einsum("td,edo->teo", x, params["kernel"])
+        if "bias" in params:
+            per_expert = per_expert + params["bias"][None, :, :]
+        act = attrs.get("activation", ActiMode.AC_MODE_NONE)
+        per_expert = apply_activation(per_expert, act)
+        out = jnp.einsum("teo,te->to", per_expert, weighted)
+        return [out]

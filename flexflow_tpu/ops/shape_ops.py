@@ -1,0 +1,133 @@
+"""Shape-manipulation operators: concat/split/reshape/transpose/reverse/flat/cast.
+
+Capability parity with reference src/ops/{concat,split,reshape,transpose,
+reverse,flat,cast}.cc. Pure metadata/layout ops — XLA handles them natively.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from flexflow_tpu.ffconst import DataType, OpType
+from flexflow_tpu.ops.base import OpImpl, register_op
+
+
+@register_op
+class Concat(OpImpl):
+    op_type = OpType.CONCAT
+
+    @staticmethod
+    def infer_output_specs(attrs, input_specs):
+        axis = attrs["axis"]
+        (s0, d0) = input_specs[0]
+        out = list(s0)
+        out[axis] = sum(s[axis] for s, _ in input_specs)
+        return [(tuple(out), d0)]
+
+    @staticmethod
+    def forward(attrs, params, inputs, ctx):
+        return [jnp.concatenate(inputs, axis=attrs["axis"])]
+
+
+@register_op
+class Split(OpImpl):
+    op_type = OpType.SPLIT
+
+    @staticmethod
+    def infer_output_specs(attrs, input_specs):
+        axis = attrs["axis"]
+        sizes = attrs["sizes"]
+        (s0, d0) = input_specs[0]
+        assert sum(sizes) == s0[axis], (sizes, s0, axis)
+        outs = []
+        for sz in sizes:
+            shape = list(s0)
+            shape[axis] = sz
+            outs.append((tuple(shape), d0))
+        return outs
+
+    @staticmethod
+    def forward(attrs, params, inputs, ctx):
+        sizes = attrs["sizes"]
+        idx = np.cumsum(sizes)[:-1].tolist()
+        return list(jnp.split(inputs[0], idx, axis=attrs["axis"]))
+
+
+@register_op
+class Reshape(OpImpl):
+    op_type = OpType.RESHAPE
+
+    @staticmethod
+    def infer_output_specs(attrs, input_specs):
+        (s0, d0) = input_specs[0]
+        shape = list(attrs["shape"])
+        if -1 in shape:
+            known = int(np.prod([d for d in shape if d != -1]))
+            shape[shape.index(-1)] = int(np.prod(s0)) // known
+        assert int(np.prod(shape)) == int(np.prod(s0)), (shape, s0)
+        return [(tuple(shape), d0)]
+
+    @staticmethod
+    def forward(attrs, params, inputs, ctx):
+        return [jnp.reshape(inputs[0], attrs["shape"])]
+
+
+@register_op
+class Transpose(OpImpl):
+    op_type = OpType.TRANSPOSE
+
+    @staticmethod
+    def infer_output_specs(attrs, input_specs):
+        (s0, d0) = input_specs[0]
+        perm = attrs["perm"]
+        return [(tuple(s0[p] for p in perm), d0)]
+
+    @staticmethod
+    def forward(attrs, params, inputs, ctx):
+        return [jnp.transpose(inputs[0], attrs["perm"])]
+
+
+@register_op
+class Reverse(OpImpl):
+    op_type = OpType.REVERSE
+
+    @staticmethod
+    def infer_output_specs(attrs, input_specs):
+        return [input_specs[0]]
+
+    @staticmethod
+    def forward(attrs, params, inputs, ctx):
+        return [jnp.flip(inputs[0], axis=attrs["axis"])]
+
+
+@register_op
+class Flat(OpImpl):
+    """Flatten all non-batch dims (reference src/ops/flat.cc)."""
+
+    op_type = OpType.FLAT
+
+    @staticmethod
+    def infer_output_specs(attrs, input_specs):
+        (s0, d0) = input_specs[0]
+        return [((s0[0], int(np.prod(s0[1:]))), d0)]
+
+    @staticmethod
+    def forward(attrs, params, inputs, ctx):
+        x = inputs[0]
+        return [jnp.reshape(x, (x.shape[0], -1))]
+
+
+@register_op
+class Cast(OpImpl):
+    op_type = OpType.CAST
+
+    @staticmethod
+    def infer_output_specs(attrs, input_specs):
+        (s0, _d0) = input_specs[0]
+        return [(s0, attrs["dtype"])]
+
+    @staticmethod
+    def forward(attrs, params, inputs, ctx):
+        return [inputs[0].astype(attrs["dtype"].to_jnp())]

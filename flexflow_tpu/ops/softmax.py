@@ -1,0 +1,28 @@
+"""Softmax operator (reference src/ops/softmax.cc 524 + kernels/softmax.cu).
+
+Train and inference share one implementation; the "last layer before loss"
+special-casing the reference does (softmax+CCE fusion) happens in
+flexflow_tpu/training/loss.py which consumes logits directly when possible.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.ffconst import OpType
+from flexflow_tpu.ops.base import OpImpl, register_op
+
+
+@register_op
+class Softmax(OpImpl):
+    op_type = OpType.SOFTMAX
+
+    @staticmethod
+    def infer_output_specs(attrs, input_specs):
+        return [input_specs[0]]
+
+    @staticmethod
+    def forward(attrs, params, inputs, ctx):
+        axis = attrs.get("axis", -1)
+        return [jax.nn.softmax(inputs[0], axis=axis)]

@@ -1,0 +1,2 @@
+from flexflow_tpu.parallel.mesh import MachineResource, make_mesh
+from flexflow_tpu.parallel.spec import ShardingPolicy

@@ -1,0 +1,69 @@
+"""Sharding policy: ParallelTensor metadata → jax NamedSharding.
+
+The reference's ParallelTensor carries per-dim {size, degree, parallel_idx,
+is_replica_dim} (reference include/flexflow/parallel_tensor.h:36) and its
+parallel ops {Repartition, Combine, Replicate, Reduction, AllReduce}
+(src/parallel_ops/) are PCG nodes that change that metadata with real data
+movement. On TPU the same vocabulary maps to sharding annotations:
+
+  Repartition(dim, degree)  -> PartitionSpec puts a mesh axis on `dim`
+  Combine(dim)              -> PartitionSpec removes the axis (all-gather)
+  Replicate()               -> axis absent from the spec (replicated)
+  Reduction()               -> psum / GSPMD-inserted reduce after partial matmul
+  AllReduce                 -> psum (XLA collective over ICI)
+
+GSPMD inserts the actual collectives when a jitted program crosses sharding
+boundaries; `flexflow_tpu/parallel/ops.py` exposes the explicit forms.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class ShardingPolicy:
+    """Resolves where each tensor lives on the mesh."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        self.axes = set(mesh.axis_names)
+
+    def _axis(self, name: Optional[str]) -> Optional[str]:
+        return name if name in self.axes and self.mesh.shape[name] > 1 else None
+
+    def batch_sharding(self, shape: Tuple[int, ...]) -> NamedSharding:
+        """Activations/batches: shard dim 0 on 'data' (+'seq' on dim 1 when
+        sequence parallelism is on). Dims that don't divide the axis stay
+        replicated (e.g. tiny eval batches)."""
+        shape = tuple(shape)
+        spec = [None] * len(shape)
+        if (shape and self._axis("data")
+                and shape[0] % self.mesh.shape["data"] == 0):
+            spec[0] = "data"
+        if (len(shape) >= 2 and self._axis("seq")
+                and shape[1] % self.mesh.shape["seq"] == 0):
+            spec[1] = "seq"
+        return NamedSharding(self.mesh, P(*spec))
+
+    def weight_sharding(self, shape: Tuple[int, ...],
+                        sharding_dims: Optional[Tuple[Optional[str], ...]]
+                        ) -> NamedSharding:
+        """Parameters: replicated over 'data', split per the op's hint over
+        'model'/'expert'. Dims that don't divide evenly fall back to
+        replication (XLA would pad; we keep it simple and correct)."""
+        if sharding_dims is None:
+            return NamedSharding(self.mesh, P())
+        spec = []
+        for dim_size, axis_name in zip(shape, sharding_dims):
+            ax = self._axis(axis_name)
+            if ax is not None and dim_size % self.mesh.shape[ax] == 0:
+                spec.append(ax)
+            else:
+                spec.append(None)
+        return NamedSharding(self.mesh, P(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())

@@ -1,0 +1,59 @@
+"""Data loading.
+
+Capability parity with reference src/dataloader/dataloader.cc
+(SingleDataLoader: load the full numpy dataset once, then per-iteration batch
+copies to device, include/flexflow/dataloader.h:34). On TPU the equivalent is:
+keep the dataset in host memory, device_put each batch with the batch
+NamedSharding so every data-parallel shard receives only its slice.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+
+
+class SingleDataLoader:
+    def __init__(self, ffmodel, input_tensor, full_array: np.ndarray,
+                 num_samples: Optional[int] = None, data_type=None):
+        self.ffmodel = ffmodel
+        self.input_tensor = input_tensor
+        self.data = np.asarray(full_array)
+        self.num_samples = num_samples or self.data.shape[0]
+        self.batch_size = ffmodel.config.batch_size
+        self.idx = 0
+
+    @property
+    def num_batches(self) -> int:
+        return self.num_samples // self.batch_size
+
+    def reset(self):
+        self.idx = 0
+
+    def next_batch(self, ffmodel=None):
+        """Returns the next batch as a device array with batch sharding."""
+        model = ffmodel or self.ffmodel
+        lo = self.idx * self.batch_size
+        hi = lo + self.batch_size
+        if hi > self.num_samples:
+            self.reset()
+            lo, hi = 0, self.batch_size
+        batch = self.data[lo:hi]
+        self.idx += 1
+        sharding = model.batch_sharding(batch.shape) if model else None
+        return jax.device_put(batch, sharding)
+
+
+def minibatches(arrays, batch_size: int, *, shuffle: bool = False, seed: int = 0):
+    """Yield tuples of aligned minibatches, dropping the ragged tail
+    (the reference trains on num_samples // batch_size full batches)."""
+    n = arrays[0].shape[0]
+    order = np.arange(n)
+    if shuffle:
+        np.random.default_rng(seed).shuffle(order)
+    for i in range(n // batch_size):
+        sel = order[i * batch_size:(i + 1) * batch_size]
+        yield tuple(a[sel] for a in arrays)

@@ -1,0 +1,42 @@
+"""Loss functions.
+
+Capability parity with reference src/loss_functions/ (Loss::backward seeds
+gradients as a Legion task). Here losses are scalar functions differentiated
+by jax.grad. When the model's final layer is Softmax and the loss is a
+cross-entropy, we consume the pre-softmax logits with log_softmax for
+stability (the reference fuses softmax+CCE similarly in its loss kernels).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.ffconst import LossType
+
+
+def compute_loss(loss_type: LossType, output, label, *, logits=None):
+    """output: model final output; logits: pre-softmax values when available."""
+    if loss_type == LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY:
+        lbl = label.reshape(label.shape[0], -1)[:, 0].astype(jnp.int32)
+        if logits is not None:
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        else:
+            logp = jnp.log(jnp.clip(output.astype(jnp.float32), 1e-30, 1.0))
+        picked = jnp.take_along_axis(logp, lbl[:, None], axis=-1)
+        return -jnp.mean(picked)
+    if loss_type == LossType.LOSS_CATEGORICAL_CROSSENTROPY:
+        if logits is not None:
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        else:
+            logp = jnp.log(jnp.clip(output.astype(jnp.float32), 1e-30, 1.0))
+        return -jnp.mean(jnp.sum(label.astype(jnp.float32) * logp, axis=-1))
+    if loss_type == LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE:
+        d = output.astype(jnp.float32) - label.astype(jnp.float32)
+        return jnp.mean(jnp.sum(jnp.square(d), axis=tuple(range(1, d.ndim))))
+    if loss_type == LossType.LOSS_MEAN_SQUARED_ERROR_SUM_REDUCE:
+        d = output.astype(jnp.float32) - label.astype(jnp.float32)
+        return jnp.sum(jnp.square(d))
+    if loss_type == LossType.LOSS_IDENTITY:
+        return jnp.mean(output.astype(jnp.float32))
+    raise ValueError(loss_type)

@@ -1,0 +1,85 @@
+"""Metrics.
+
+Capability parity with reference src/metrics_functions/ (PerfMetrics future
+chain: per-batch counters accumulated across iterations,
+include/flexflow/metrics_functions.h). Here a PerfMetrics is a plain
+accumulator updated from per-step jnp scalars.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+import jax.numpy as jnp
+
+from flexflow_tpu.ffconst import LossType, MetricsType
+
+
+@dataclasses.dataclass
+class PerfMetrics:
+    train_all: int = 0
+    train_correct: int = 0
+    cce_loss: float = 0.0
+    sparse_cce_loss: float = 0.0
+    mse_loss: float = 0.0
+    rmse_loss: float = 0.0
+    mae_loss: float = 0.0
+
+    def update(self, step_metrics: Dict[str, float], batch_size: int):
+        self.train_all += batch_size
+        if "accuracy_correct" in step_metrics:
+            self.train_correct += int(step_metrics["accuracy_correct"])
+        for k in ("cce_loss", "sparse_cce_loss", "mse_loss", "rmse_loss",
+                  "mae_loss"):
+            if k in step_metrics:
+                setattr(self, k, getattr(self, k) + float(step_metrics[k]))
+
+    @property
+    def accuracy(self) -> float:
+        return self.train_correct / max(1, self.train_all)
+
+    def report(self) -> str:
+        parts = [f"train_all={self.train_all}"]
+        if self.train_correct:
+            parts.append(f"accuracy={100.0 * self.accuracy:.2f}%")
+        for k in ("cce_loss", "sparse_cce_loss", "mse_loss"):
+            v = getattr(self, k)
+            if v:
+                parts.append(f"{k}={v / max(1, self.train_all):.4f}")
+        return " ".join(parts)
+
+
+def compute_step_metrics(metrics: List[MetricsType], output, label,
+                         loss_type: LossType) -> Dict[str, jnp.ndarray]:
+    """Per-batch metric values (summed over the batch, to be accumulated)."""
+    out: Dict[str, jnp.ndarray] = {}
+    sparse = label.ndim < output.ndim or label.shape[-1] == 1
+    for m in metrics:
+        if m == MetricsType.METRICS_ACCURACY:
+            if sparse:
+                lbl = label.reshape(label.shape[0], -1)[:, 0].astype(jnp.int32)
+                pred = jnp.argmax(output, axis=-1).astype(jnp.int32)
+                out["accuracy_correct"] = jnp.sum(pred == lbl)
+            else:
+                pred = jnp.argmax(output, axis=-1)
+                lbl = jnp.argmax(label, axis=-1)
+                out["accuracy_correct"] = jnp.sum(pred == lbl)
+        elif m == MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY:
+            lbl = label.reshape(label.shape[0], -1)[:, 0].astype(jnp.int32)
+            logp = jnp.log(jnp.clip(output.astype(jnp.float32), 1e-30, 1.0))
+            out["sparse_cce_loss"] = -jnp.sum(
+                jnp.take_along_axis(logp, lbl[:, None], axis=-1))
+        elif m == MetricsType.METRICS_CATEGORICAL_CROSSENTROPY:
+            logp = jnp.log(jnp.clip(output.astype(jnp.float32), 1e-30, 1.0))
+            out["cce_loss"] = -jnp.sum(label.astype(jnp.float32) * logp)
+        elif m == MetricsType.METRICS_MEAN_SQUARED_ERROR:
+            d = output.astype(jnp.float32) - label.astype(jnp.float32)
+            out["mse_loss"] = jnp.sum(jnp.mean(jnp.square(d), axis=-1))
+        elif m == MetricsType.METRICS_ROOT_MEAN_SQUARED_ERROR:
+            d = output.astype(jnp.float32) - label.astype(jnp.float32)
+            out["rmse_loss"] = jnp.sum(jnp.sqrt(jnp.mean(jnp.square(d), axis=-1)))
+        elif m == MetricsType.METRICS_MEAN_ABSOLUTE_ERROR:
+            d = output.astype(jnp.float32) - label.astype(jnp.float32)
+            out["mae_loss"] = jnp.sum(jnp.mean(jnp.abs(d), axis=-1))
+    return out

@@ -1,0 +1,37 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+The reference can only test multi-node behavior on real 2-node CI clusters
+(reference tests/multinode_helpers/, .github/workflows/multinode-test.yml);
+on TPU/JAX we get a faithful multi-device SPMD simulation for free via
+--xla_force_host_platform_device_count (SURVEY §4 "Implication").
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize forces jax_platforms="axon,cpu" (real TPU tunnel);
+# tests must run on the virtual 8-device CPU mesh, so force CPU here, after
+# import but before any backend initialization.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()
+
+
+@pytest.fixture(autouse=True)
+def _reset_layer_naming():
+    from flexflow_tpu.core.layer import Layer
+
+    Layer.reset_naming()
+    yield

@@ -1,0 +1,222 @@
+"""Per-op alignment tests vs pure numpy/jax references.
+
+Models the reference's tests/align/ strategy (run each op in FF and in
+PyTorch, assert allclose — tests/align/README.md): here the oracle is
+jax/numpy computed directly, the "FF" side goes through the full
+graph-builder + compiled executor.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import flexflow_tpu as ff
+from flexflow_tpu.ops.base import OpContext
+from flexflow_tpu.ffconst import DataType
+
+
+def run_single_op(build_fn, feeds, config=None):
+    """Build a model with build_fn(model, input_tensors), compile inference,
+    run with feeds (list of np arrays), return np outputs."""
+    model = ff.FFModel(config or ff.FFConfig(batch_size=feeds[0].shape[0]))
+    outs = build_fn(model)
+    model.compile()
+    result = model.predict([np.asarray(f) for f in feeds])
+    return result
+
+
+def test_dense_matches_numpy():
+    x = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+
+    def build(m):
+        t = m.create_tensor([4, 16], ff.DataType.DT_FLOAT)
+        return m.dense(t, 8)
+
+    model = ff.FFModel(ff.FFConfig(batch_size=4))
+    t = model.create_tensor([4, 16], ff.DataType.DT_FLOAT)
+    out = model.dense(t, 8)
+    model.compile()
+    kernel = model.params["linear"]["kernel"]
+    bias = model.params["linear"]["bias"]
+    got = model.predict([x])
+    want = x @ np.asarray(kernel) + np.asarray(bias)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_dense_activation_and_no_bias():
+    x = np.random.RandomState(1).randn(4, 8).astype(np.float32)
+    model = ff.FFModel(ff.FFConfig(batch_size=4))
+    t = model.create_tensor([4, 8], ff.DataType.DT_FLOAT)
+    out = model.dense(t, 8, ff.ActiMode.AC_MODE_RELU, use_bias=False)
+    model.compile()
+    kernel = np.asarray(model.params["linear"]["kernel"])
+    got = model.predict([x])
+    want = np.maximum(x @ kernel, 0)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    assert "bias" not in model.params["linear"]
+
+
+def test_elementwise_binary_broadcast():
+    a = np.random.RandomState(2).randn(4, 8).astype(np.float32)
+    b = np.random.RandomState(3).randn(4, 8).astype(np.float32)
+    model = ff.FFModel(ff.FFConfig(batch_size=4))
+    ta = model.create_tensor([4, 8], ff.DataType.DT_FLOAT)
+    tb = model.create_tensor([4, 8], ff.DataType.DT_FLOAT)
+    out = model.multiply(model.add(ta, tb), model.subtract(ta, tb))
+    model.compile()
+    got = model.predict([a, b])
+    np.testing.assert_allclose(got, (a + b) * (a - b), rtol=1e-5, atol=1e-5)
+
+
+def test_softmax_layernorm_rmsnorm():
+    x = np.random.RandomState(4).randn(4, 32).astype(np.float32)
+    model = ff.FFModel(ff.FFConfig(batch_size=4))
+    t = model.create_tensor([4, 32], ff.DataType.DT_FLOAT)
+    s = model.softmax(t)
+    model.compile()
+    got = model.predict([x])
+    want = jax.nn.softmax(jnp.asarray(x), axis=-1)
+    np.testing.assert_allclose(got, np.asarray(want), rtol=1e-5, atol=1e-6)
+
+    model2 = ff.FFModel(ff.FFConfig(batch_size=4))
+    t2 = model2.create_tensor([4, 32], ff.DataType.DT_FLOAT)
+    n2 = model2.layer_norm(t2, axes=[1])
+    model2.compile()
+    got2 = model2.predict([x])
+    mean = x.mean(-1, keepdims=True)
+    var = ((x - mean) ** 2).mean(-1, keepdims=True)
+    want2 = (x - mean) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(got2, want2, rtol=1e-4, atol=1e-5)
+
+    model3 = ff.FFModel(ff.FFConfig(batch_size=4))
+    t3 = model3.create_tensor([4, 32], ff.DataType.DT_FLOAT)
+    n3 = model3.rms_norm(t3, eps=1e-6)
+    model3.compile()
+    got3 = model3.predict([x])
+    want3 = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(got3, want3, rtol=1e-4, atol=1e-5)
+
+
+def test_shape_ops_roundtrip():
+    x = np.arange(4 * 6, dtype=np.float32).reshape(4, 6)
+    model = ff.FFModel(ff.FFConfig(batch_size=4))
+    t = model.create_tensor([4, 6], ff.DataType.DT_FLOAT)
+    r = model.reshape(t, [4, 2, 3])
+    tr = model.transpose(r, [0, 2, 1])
+    fl = model.flat(tr)
+    model.compile()
+    got = model.predict([x])
+    want = x.reshape(4, 2, 3).transpose(0, 2, 1).reshape(4, -1)
+    np.testing.assert_allclose(got, want)
+
+
+def test_concat_split():
+    x = np.random.RandomState(5).randn(4, 10).astype(np.float32)
+    model = ff.FFModel(ff.FFConfig(batch_size=4))
+    t = model.create_tensor([4, 10], ff.DataType.DT_FLOAT)
+    parts = model.split(t, [4, 6], axis=1)
+    cat = model.concat([parts[1], parts[0]], axis=1)
+    model.compile()
+    got = model.predict([x])
+    want = np.concatenate([x[:, 4:], x[:, :4]], axis=1)
+    np.testing.assert_allclose(got, want)
+
+
+def test_embedding():
+    ids = np.array([[1, 2], [3, 0]], dtype=np.int32)
+    model = ff.FFModel(ff.FFConfig(batch_size=2))
+    t = model.create_tensor([2, 2], ff.DataType.DT_INT32)
+    e = model.embedding(t, num_entries=10, out_dim=5)
+    model.compile()
+    got = model.predict([ids])
+    table = np.asarray(model.params["embedding"]["weight"])
+    np.testing.assert_allclose(got, table[ids], rtol=1e-6)
+
+
+def test_conv2d_pool2d_shapes_and_values():
+    x = np.random.RandomState(6).randn(2, 3, 8, 8).astype(np.float32)
+    model = ff.FFModel(ff.FFConfig(batch_size=2))
+    t = model.create_tensor([2, 3, 8, 8], ff.DataType.DT_FLOAT)
+    c = model.conv2d(t, 4, 3, 3, 1, 1, 1, 1)
+    p = model.pool2d(c, 2, 2, 2, 2, 0, 0)
+    model.compile()
+    got = model.predict([x])
+    assert got.shape == (2, 4, 4, 4)
+    # value check vs jax reference for the conv
+    kernel = np.asarray(model.params["conv2d"]["kernel"])
+    bias = np.asarray(model.params["conv2d"]["bias"])
+    conv = jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(kernel), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    conv = np.asarray(conv) + bias.reshape(1, -1, 1, 1)
+    want = conv.reshape(2, 4, 4, 2, 4, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_batch_matmul():
+    a = np.random.RandomState(7).randn(3, 4, 5).astype(np.float32)
+    b = np.random.RandomState(8).randn(3, 5, 6).astype(np.float32)
+    model = ff.FFModel(ff.FFConfig(batch_size=3))
+    ta = model.create_tensor([3, 4, 5], ff.DataType.DT_FLOAT)
+    tb = model.create_tensor([3, 5, 6], ff.DataType.DT_FLOAT)
+    out = model.batch_matmul(ta, tb)
+    model.compile()
+    got = model.predict([a, b])
+    np.testing.assert_allclose(got, a @ b, rtol=1e-4, atol=1e-4)
+
+
+def test_topk_argmax_gather():
+    x = np.random.RandomState(9).randn(4, 16).astype(np.float32)
+    model = ff.FFModel(ff.FFConfig(batch_size=4))
+    t = model.create_tensor([4, 16], ff.DataType.DT_FLOAT)
+    values, indices = model.top_k(t, 3)
+    model.compile()
+    # final output is indices (last layer output 0 is values) — use predict on
+    # the graph's last layer: TopK returns [values, indices]; final tensor is
+    # values. Check via direct op access instead.
+    got_vals = model.predict([x])
+    want_vals = np.sort(x, axis=-1)[:, ::-1][:, :3]
+    np.testing.assert_allclose(got_vals, want_vals, rtol=1e-6)
+
+
+def test_scalar_and_unary_chain():
+    x = np.random.RandomState(10).rand(4, 8).astype(np.float32) + 0.5
+    model = ff.FFModel(ff.FFConfig(batch_size=4))
+    t = model.create_tensor([4, 8], ff.DataType.DT_FLOAT)
+    y = model.scalar_multiply(t, 2.0)
+    y = model.scalar_add(y, 1.0)
+    y = model.rsqrt(y)
+    model.compile()
+    got = model.predict([x])
+    np.testing.assert_allclose(got, 1.0 / np.sqrt(2 * x + 1), rtol=1e-4)
+
+
+def test_multihead_attention_self():
+    x = np.random.RandomState(11).randn(2, 6, 16).astype(np.float32)
+    model = ff.FFModel(ff.FFConfig(batch_size=2))
+    t = model.create_tensor([2, 6, 16], ff.DataType.DT_FLOAT)
+    out = model.multihead_attention(t, t, t, embed_dim=16, num_heads=4)
+    model.compile()
+    got = model.predict([x])
+    assert got.shape == (2, 6, 16)
+    # oracle: recompute with the initialized weights
+    p = {k: np.asarray(v) for k, v in model.params["multihead_attention"].items()}
+    q = (x @ p["wq"]).reshape(2, 6, 4, 4)
+    k = (x @ p["wk"]).reshape(2, 6, 4, 4)
+    v = (x @ p["wv"]).reshape(2, 6, 4, 4)
+    scores = np.einsum("bqhd,bkhd->bhqk", q, k) / 2.0
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(scores), axis=-1))
+    o = np.einsum("bhqk,bkhd->bqhd", probs, v).reshape(2, 6, 16) @ p["wo"]
+    np.testing.assert_allclose(got, o, rtol=1e-4, atol=1e-4)
+
+
+def test_dropout_train_vs_eval():
+    x = np.ones((8, 32), np.float32)
+    model = ff.FFModel(ff.FFConfig(batch_size=8))
+    t = model.create_tensor([8, 32], ff.DataType.DT_FLOAT)
+    d = model.dropout(t, rate=0.5)
+    model.compile()
+    got = model.predict([x])  # eval mode: identity
+    np.testing.assert_allclose(got, x)
