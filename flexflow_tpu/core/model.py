@@ -411,6 +411,36 @@ class FFModel:
             outs.append(self.dense(h, input.dims[-1]))
         return self.aggregate(values, assign, outs, num_exp, lambda_bal)
 
+    # --- parallel ops (reference src/parallel_ops/; sharding boundaries) ---
+    def repartition(self, input: Tensor, repartition_dim: int,
+                    repartition_degree: int = 0, axis_name: str = "data",
+                    name=None):
+        return self._add_layer(OpType.REPARTITION, [input],
+                               dict(repartition_dim=repartition_dim,
+                                    repartition_degree=repartition_degree,
+                                    axis_name=axis_name), name)
+
+    def combine(self, input: Tensor, combine_dim: int = 0,
+                combine_degree: int = 0, name=None):
+        return self._add_layer(OpType.COMBINE, [input],
+                               dict(combine_dim=combine_dim,
+                                    combine_degree=combine_degree), name)
+
+    def replicate(self, input: Tensor, replicate_dim: int = 0,
+                  replicate_degree: int = 0, name=None):
+        return self._add_layer(OpType.REPLICATE, [input],
+                               dict(replicate_dim=replicate_dim,
+                                    replicate_degree=replicate_degree), name)
+
+    def reduction(self, input: Tensor, reduction_dim: int = 0,
+                  reduction_degree: int = 0, name=None):
+        return self._add_layer(OpType.REDUCTION, [input],
+                               dict(reduction_dim=reduction_dim,
+                                    reduction_degree=reduction_degree), name)
+
+    def allreduce(self, input: Tensor, name=None):
+        return self._add_layer(OpType.ALLREDUCE, [input], {}, name)
+
     # ==================================================================
     # Graph execution
     # ==================================================================

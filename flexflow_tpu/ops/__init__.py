@@ -20,4 +20,5 @@ from flexflow_tpu.ops import (  # noqa: F401
     shape_ops,
     softmax,
 )
+from flexflow_tpu.parallel import ops as parallel_ops  # noqa: F401  (registers)
 from flexflow_tpu.ops.base import OpContext, get_op_impl, register_op

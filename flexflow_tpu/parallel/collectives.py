@@ -1,0 +1,44 @@
+"""Explicit collective wrappers for shard_map-style SPMD code.
+
+The reference's only collective library is NCCL (allreduce for grad sync + TP,
+SURVEY §2.4). On TPU the full set rides ICI via XLA: psum, all_gather,
+reduce_scatter, ppermute, all_to_all. These helpers are used by code written
+with jax.shard_map (pipeline schedules, ring attention) where collectives are
+explicit rather than GSPMD-inserted.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def psum(x, axis_name: str):
+    return lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name: str):
+    return lax.pmean(x, axis_name)
+
+
+def all_gather(x, axis_name: str, *, axis: int = 0, tiled: bool = True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: str, *, scatter_dimension: int = 0):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dimension,
+                            tiled=True)
+
+
+def ppermute_shift(x, axis_name: str, shift: int = 1):
+    """Ring shift: device i sends to (i+shift) mod n — the building block of
+    ring attention / pipelined all-gather."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int):
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)

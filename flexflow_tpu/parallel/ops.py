@@ -1,0 +1,131 @@
+"""Parallel operators — the parallelism vocabulary as graph nodes.
+
+Capability parity with reference src/parallel_ops/{partition,combine,replicate,
+reduction,allreduce,fused_parallel_op}.cc (SURVEY §2.3): in the reference these
+are PCG nodes with real data-movement kernels (Legion region copies, strided
+add, ncclAllReduce). On TPU each becomes a GSPMD sharding annotation:
+
+  Repartition(dim, degree) -> constraint placing a mesh axis on `dim`
+  Combine(dim)             -> constraint removing the axis from `dim` only
+                              (other dims left UNCONSTRAINED for GSPMD)
+  Replicate()              -> fully-replicated constraint (XLA broadcasts;
+                              reverse-mode grad is the psum the reference
+                              implements by hand)
+  Reduction(dim)           -> reduce partial values and scatter along `dim`
+                              (reference: sum-reduce the replica dim); XLA
+                              lowers to reduce-scatter where profitable
+  AllReduce                -> replicated constraint at a TP boundary; XLA
+                              inserts the psum (explicit shard_map forms live
+                              in parallel/collectives.py)
+
+The nodes exist so graphs (and later the Unity search, which *inserts* these
+nodes) can express where layout changes happen, exactly like the reference.
+Degree arguments are validated against the mesh: GSPMD shards over whole named
+axes, so a degree that disagrees with the axis size is an error rather than a
+silent different layout.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from flexflow_tpu.ffconst import OpType
+from flexflow_tpu.ops.base import OpImpl, register_op, register_op_as
+
+UNC = P.UNCONSTRAINED
+
+
+def _unconstrained_spec(ndim):
+    return [UNC] * ndim
+
+
+def _constrain(x, mesh, spec_list):
+    if mesh is None or mesh.devices.size == 1:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*spec_list)))
+
+
+def _check_degree(attrs, key, mesh, axis):
+    """Degree must match the mesh axis size (or be 0/None = 'use the axis')."""
+    degree = attrs.get(key) or 0
+    if degree and mesh is not None and axis in mesh.axis_names \
+            and degree != mesh.shape[axis]:
+        raise ValueError(
+            f"{key}={degree} does not match mesh axis '{axis}' of size "
+            f"{mesh.shape[axis]}; GSPMD shards over whole named axes")
+
+
+class _ParallelOp(OpImpl):
+    @staticmethod
+    def infer_output_specs(attrs, input_specs):
+        return [input_specs[0]]
+
+
+@register_op
+class Repartition(_ParallelOp):
+    op_type = OpType.REPARTITION
+
+    @staticmethod
+    def forward(attrs, params, inputs, ctx):
+        x = inputs[0]
+        dim = attrs["repartition_dim"] % x.ndim
+        axis = attrs.get("axis_name", "data")
+        mesh = ctx.mesh
+        _check_degree(attrs, "repartition_degree", mesh, axis)
+        if (mesh is None or axis not in mesh.axis_names
+                or x.shape[dim] % mesh.shape[axis] != 0):
+            return [x]  # precondition failed: leave sharding untouched
+        spec = _unconstrained_spec(x.ndim)
+        spec[dim] = axis
+        return [_constrain(x, mesh, spec)]
+
+
+@register_op
+class Combine(_ParallelOp):
+    op_type = OpType.COMBINE
+
+    @staticmethod
+    def forward(attrs, params, inputs, ctx):
+        x = inputs[0]
+        dim = attrs.get("combine_dim", 0) % x.ndim
+        spec = _unconstrained_spec(x.ndim)
+        spec[dim] = None  # gather this dim only; others left to GSPMD
+        return [_constrain(x, ctx.mesh, spec)]
+
+
+@register_op
+class Reduction(_ParallelOp):
+    """Sum partial values and leave the result scattered along reduction_dim
+    (the reference's post-row-parallel-linear reduce, reduction.cc)."""
+
+    op_type = OpType.REDUCTION
+
+    @staticmethod
+    def forward(attrs, params, inputs, ctx):
+        x = inputs[0]
+        dim = attrs.get("reduction_dim", 0) % x.ndim
+        axis = attrs.get("axis_name", "model")
+        mesh = ctx.mesh
+        _check_degree(attrs, "reduction_degree", mesh, axis)
+        if (mesh is None or axis not in mesh.axis_names
+                or x.shape[dim] % mesh.shape[axis] != 0):
+            return [x]
+        spec = _unconstrained_spec(x.ndim)
+        spec[dim] = axis
+        return [_constrain(x, mesh, spec)]
+
+
+@register_op_as(OpType.REPLICATE, OpType.ALLREDUCE)
+class ReplicateOrAllReduce(_ParallelOp):
+    """Both lower to a fully-replicated constraint: Replicate broadcasts a
+    value to all shards; AllReduce marks the boundary where XLA must psum
+    partial results into a replicated tensor."""
+
+    op_type = OpType.ALLREDUCE
+
+    @staticmethod
+    def forward(attrs, params, inputs, ctx):
+        x = inputs[0]
+        return [_constrain(x, ctx.mesh, [None] * x.ndim)]
