@@ -57,6 +57,14 @@ class FFConfig:
     param_dtype: str = "float32"
     compute_dtype: str = "float32"
 
+    # --- serving shapes (reference BatchConfig::max_requests_per_batch /
+    # max_tokens_per_batch / max_sequence_length, batch_config.h:46-48,
+    # configured by RequestManager; defaults match serve.py compile args) ---
+    max_requests_per_batch: int = 8
+    max_tokens_per_batch: int = 128
+    max_sequence_length: int = 256
+    kv_cache_dtype: str = "bfloat16"
+
     # --- serving / offload / quantization (reference config.h:144-163) ---
     cpu_offload: bool = False
     offload_reserve_space_size: int = 8 * 1024 * 1024 * 1024

@@ -219,6 +219,104 @@ class FFModel:
                                     add_zero_attn=add_zero_attn,
                                     kernel_initializer=kernel_initializer), name)
 
+    # --- serving attention family (reference model.h:700-790:
+    # inc_multihead_self_attention / inc_multiquery_self_attention and the
+    # spec_inc_* / tree_inc_* variants) ---
+    def _serving_attention(self, op_type: OpType, input: Tensor,
+                           embed_dim: int, num_q_heads: int, num_kv_heads: int,
+                           kdim: int, vdim: int, dropout: float, bias: bool,
+                           add_bias_kv: bool, add_zero_attn: bool,
+                           data_type, kernel_initializer,
+                           apply_rotary_embedding: bool, scaling_query: bool,
+                           scaling_factor: float, qk_prod_scaling: bool,
+                           position_bias: bool, rope_theta: float,
+                           name) -> Tensor:
+        if add_bias_kv or add_zero_attn:
+            raise NotImplementedError(
+                "add_bias_kv/add_zero_attn are not supported by the serving "
+                "attention ops (the reference also ignores them here)")
+        if vdim and vdim != (kdim or embed_dim):
+            raise NotImplementedError("vdim != kdim serving attention")
+        head_dim = (kdim or embed_dim) // num_q_heads
+        return self._add_layer(op_type, [input], dict(
+            embed_dim=embed_dim, num_q_heads=num_q_heads,
+            num_kv_heads=num_kv_heads, head_dim=head_dim, dropout=dropout,
+            bias=bias, add_bias_kv=add_bias_kv, add_zero_attn=add_zero_attn,
+            data_type=data_type, kernel_initializer=kernel_initializer,
+            apply_rotary_embedding=apply_rotary_embedding,
+            scaling_query=scaling_query, scaling_factor=scaling_factor,
+            qk_prod_scaling=qk_prod_scaling, position_bias=position_bias,
+            rope_theta=rope_theta,
+            max_requests=self.config.max_requests_per_batch,
+            max_seq_length=self.config.max_sequence_length,
+            cache_dtype=self.config.kv_cache_dtype), name)
+
+    def inc_multihead_self_attention(self, input: Tensor, embed_dim: int,
+                                     num_heads: int, **kw) -> Tensor:
+        return self.inc_multiquery_self_attention(input, embed_dim, num_heads,
+                                                  num_heads, **kw)
+
+    def inc_multiquery_self_attention(
+            self, input: Tensor, embed_dim: int, num_q_heads: int,
+            num_kv_heads: int, kdim: int = 0, vdim: int = 0,
+            dropout: float = 0.0, bias: bool = False,
+            add_bias_kv: bool = False, add_zero_attn: bool = False,
+            data_type: Optional[DataType] = None, kernel_initializer=None,
+            apply_rotary_embedding: bool = False, scaling_query: bool = False,
+            scaling_factor: float = 1.0, qk_prod_scaling: bool = True,
+            position_bias: bool = False, rope_theta: float = 10000.0,
+            name: Optional[str] = None) -> Tensor:
+        return self._serving_attention(
+            OpType.INC_MULTIHEAD_SELF_ATTENTION, input, embed_dim, num_q_heads,
+            num_kv_heads, kdim, vdim, dropout, bias, add_bias_kv,
+            add_zero_attn, data_type, kernel_initializer,
+            apply_rotary_embedding, scaling_query, scaling_factor,
+            qk_prod_scaling, position_bias, rope_theta, name)
+
+    def spec_inc_multihead_self_attention(self, input: Tensor, embed_dim: int,
+                                          num_heads: int, **kw) -> Tensor:
+        return self.spec_inc_multiquery_self_attention(
+            input, embed_dim, num_heads, num_heads, **kw)
+
+    def spec_inc_multiquery_self_attention(
+            self, input: Tensor, embed_dim: int, num_q_heads: int,
+            num_kv_heads: int, kdim: int = 0, vdim: int = 0,
+            dropout: float = 0.0, bias: bool = False,
+            add_bias_kv: bool = False, add_zero_attn: bool = False,
+            data_type: Optional[DataType] = None, kernel_initializer=None,
+            apply_rotary_embedding: bool = False, scaling_query: bool = False,
+            scaling_factor: float = 1.0, qk_prod_scaling: bool = True,
+            position_bias: bool = False, rope_theta: float = 10000.0,
+            name: Optional[str] = None) -> Tensor:
+        return self._serving_attention(
+            OpType.SPEC_INC_MULTIHEAD_SELF_ATTENTION, input, embed_dim,
+            num_q_heads, num_kv_heads, kdim, vdim, dropout, bias, add_bias_kv,
+            add_zero_attn, data_type, kernel_initializer,
+            apply_rotary_embedding, scaling_query, scaling_factor,
+            qk_prod_scaling, position_bias, rope_theta, name)
+
+    def tree_inc_multihead_self_attention(self, input: Tensor, embed_dim: int,
+                                          num_heads: int, **kw) -> Tensor:
+        return self.tree_inc_multiquery_self_attention(
+            input, embed_dim, num_heads, num_heads, **kw)
+
+    def tree_inc_multiquery_self_attention(
+            self, input: Tensor, embed_dim: int, num_q_heads: int,
+            num_kv_heads: int, kdim: int = 0, vdim: int = 0,
+            dropout: float = 0.0, bias: bool = False,
+            add_bias_kv: bool = False, add_zero_attn: bool = False,
+            data_type: Optional[DataType] = None, kernel_initializer=None,
+            apply_rotary_embedding: bool = False, scaling_query: bool = False,
+            scaling_factor: float = 1.0, qk_prod_scaling: bool = True,
+            position_bias: bool = False, rope_theta: float = 10000.0,
+            name: Optional[str] = None) -> Tensor:
+        return self._serving_attention(
+            OpType.TREE_INC_MULTIHEAD_SELF_ATTENTION, input, embed_dim,
+            num_q_heads, num_kv_heads, kdim, vdim, dropout, bias, add_bias_kv,
+            add_zero_attn, data_type, kernel_initializer,
+            apply_rotary_embedding, scaling_query, scaling_factor,
+            qk_prod_scaling, position_bias, rope_theta, name)
+
     # --- elementwise binary ---
     def add(self, x, y, name=None):
         return self._add_layer(OpType.EW_ADD, [x, y], {}, name)

@@ -1,0 +1,17 @@
+"""Serving model zoo.
+
+Capability parity with the reference model zoo (reference inference/models/
+llama.cc, opt.cc, falcon.cc, mpt.cc, starcoder.cc and their Python twins in
+python/flexflow/serve/models/): each model family is a builder that records
+the decoder graph through the FFModel op-builder surface, plus a HuggingFace
+state-dict name mapping so real checkpoints load.
+"""
+
+from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+from flexflow_tpu.models.hf_utils import load_hf_state_dict
+
+__all__ = [
+    "LLAMAConfig",
+    "create_llama_model",
+    "load_hf_state_dict",
+]

@@ -11,6 +11,7 @@ from flexflow_tpu.ops import (  # noqa: F401
     dropout,
     elementwise,
     embedding,
+    inc_attention,
     linear,
     matmul,
     moe,

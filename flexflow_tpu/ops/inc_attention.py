@@ -1,0 +1,328 @@
+"""Serving attention: incremental, speculative, and tree-verify variants.
+
+Capability parity with the reference's serving-attention op family
+(reference src/ops/inc_multihead_self_attention.cu ~1,259 LoC:
+fused qkv projection -> rotary -> per-request KV-cache append
+(update_kv_cache_kernel :376) -> attention (compute_attention_kernel :560)
+-> output projection; spec_inc_multihead_self_attention.cu for the
+draft-model side; tree_inc_multihead_self_attention.cu for verification with
+commit_tokens_kernel :35 and the causal tree mask).
+
+TPU-first redesign: the KV cache is a functional array
+``[max_requests, max_seq, kv_heads, head_dim]`` threaded through the jitted
+step (donated, so XLA aliases it in place — no copy). The cache append is a
+vectorized scatter over request slots; attention is one batched einsum over
+the full cache with a position mask, which maps directly onto the MXU. GQA
+and MQA (reference inc_multiquery_self_attention, model.h:746) fall out of a
+``[kv_heads, group]`` reshape. All requests advance in one SPMD program —
+the reference instead launches per-op Legion tasks and loops over requests
+inside the kernel.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.core.layer import WeightSpec
+from flexflow_tpu.core.initializer import default_kernel_initializer, ZeroInitializer
+from flexflow_tpu.ffconst import DataType, OpType
+from flexflow_tpu.ops.base import OpImpl, register_op, register_op_as
+
+
+# ----------------------------------------------------------------------
+# Rotary position embedding (reference apply_rotary_embd in
+# inc_multihead_self_attention.cu; HF-LLaMA "NeoX" rotate-half convention,
+# which is the alignment oracle for the model zoo).
+# ----------------------------------------------------------------------
+def rotary_cos_sin(positions: jnp.ndarray, head_dim: int, theta: float,
+                   dtype) -> tuple:
+    """positions [R, Q] -> cos/sin [R, Q, head_dim]."""
+    inv_freq = 1.0 / (theta ** (
+        jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [R,Q,D/2]
+    angles = jnp.concatenate([angles, angles], axis=-1)           # [R,Q,D]
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rotary(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray
+                 ) -> jnp.ndarray:
+    """x [R, Q, heads, D]; cos/sin [R, Q, D]."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    return x * cos[:, :, None, :] + rotated * sin[:, :, None, :]
+
+
+# ----------------------------------------------------------------------
+# KV cache update (reference update_kv_cache_kernel, inc_mha.cu:376)
+# ----------------------------------------------------------------------
+def append_kv(cache: jnp.ndarray, new: jnp.ndarray, start_pos: jnp.ndarray,
+              num_tokens: jnp.ndarray, active: jnp.ndarray) -> jnp.ndarray:
+    """Scatter new [R, Q, KH, D] into cache [R, S, KH, D] at per-slot offsets.
+
+    Padding tokens and inactive slots are routed out of bounds and dropped.
+    """
+    R, Q = new.shape[0], new.shape[1]
+    S = cache.shape[1]
+    rows = jnp.arange(R)[:, None]                                   # [R, 1]
+    cols = start_pos[:, None] + jnp.arange(Q)[None, :]              # [R, Q]
+    valid = (jnp.arange(Q)[None, :] < num_tokens[:, None]) & active[:, None]
+    cols = jnp.where(valid, cols, S)  # out of bounds -> dropped
+    return cache.at[rows, cols].set(new.astype(cache.dtype), mode="drop")
+
+
+def _qkv(attrs, params, x, compute_dtype):
+    """Project x [R, Q, E] -> q [R,Q,H,D], k/v [R,Q,KH,D]."""
+    H = attrs["num_q_heads"]
+    KH = attrs["num_kv_heads"]
+    D = attrs["head_dim"]
+    q = x @ params["wq"]
+    k = x @ params["wk"]
+    v = x @ params["wv"]
+    if "bq" in params:
+        q, k, v = q + params["bq"], k + params["bk"], v + params["bv"]
+    R, Q = x.shape[0], x.shape[1]
+    return (q.reshape(R, Q, H, D), k.reshape(R, Q, KH, D),
+            v.reshape(R, Q, KH, D))
+
+
+def alibi_slopes(num_heads: int) -> jnp.ndarray:
+    """ALiBi per-head slopes (press et al.; matches HF MPT build_alibi_bias
+    for power-of-two head counts, which all zoo models have)."""
+    closest = 2 ** math.floor(math.log2(num_heads))
+    base = jnp.arange(1, closest + 1, dtype=jnp.float32)
+    slopes = 2.0 ** (-8.0 * base / closest)
+    if closest < num_heads:
+        extra = 2.0 ** (-4.0 * base / closest)
+        slopes = jnp.concatenate([slopes, extra[: num_heads - closest]])
+    return slopes
+
+
+def _attend(attrs, q, k_cache, v_cache, key_mask, out_dtype, qpos=None):
+    """q [R,Q,H,D] x cache [R,S,KH,D] -> [R, Q, H*D].
+
+    key_mask [R, Q, S] says which cache positions each query may see;
+    qpos [R, Q] absolute query positions (for ALiBi position bias).
+    """
+    H = attrs["num_q_heads"]
+    KH = attrs["num_kv_heads"]
+    D = attrs["head_dim"]
+    G = H // KH
+    R, Q = q.shape[0], q.shape[1]
+    S = k_cache.shape[1]
+    qg = q.reshape(R, Q, KH, G, D)
+    kc = k_cache.astype(q.dtype)
+    vc = v_cache.astype(q.dtype)
+    scores = jnp.einsum("rqkgd,rskd->rkgqs", qg, kc,
+                        preferred_element_type=jnp.float32)
+    if attrs.get("qk_prod_scaling", True):
+        scores = scores / math.sqrt(D)
+    if attrs.get("scaling_query", False):
+        scores = scores * attrs.get("scaling_factor", 1.0)
+    if attrs.get("position_bias", False):
+        dist = (qpos[:, :, None] - jnp.arange(S)[None, None, :]
+                ).astype(jnp.float32)                            # [R,Q,S]
+        bias = -alibi_slopes(H).reshape(KH, G)[None, :, :, None, None] \
+            * dist[:, None, None, :, :]
+        scores = scores + bias
+    scores = jnp.where(key_mask[:, None, None, :, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("rkgqs,rskd->rqkgd", probs, vc)
+    return out.reshape(R, Q, H * D).astype(out_dtype)
+
+
+def _weight_specs(attrs, input_specs):
+    (shape, d) = input_specs[0]
+    E = shape[-1]
+    H, KH, D = attrs["num_q_heads"], attrs["num_kv_heads"], attrs["head_dim"]
+    dt = attrs.get("data_type") or d
+    init = attrs.get("kernel_initializer") or default_kernel_initializer()
+    specs = [
+        WeightSpec("wq", (E, H * D), dt, init, sharding_dims=(None, "model")),
+        WeightSpec("wk", (E, KH * D), dt, init, sharding_dims=(None, "model")),
+        WeightSpec("wv", (E, KH * D), dt, init, sharding_dims=(None, "model")),
+        WeightSpec("wo", (H * D, E), dt, init, sharding_dims=("model", None)),
+    ]
+    if attrs.get("bias", False):
+        zero = ZeroInitializer()
+        specs += [
+            WeightSpec("bq", (H * D,), dt, zero, sharding_dims=("model",)),
+            WeightSpec("bk", (KH * D,), dt, zero, sharding_dims=("model",)),
+            WeightSpec("bv", (KH * D,), dt, zero, sharding_dims=("model",)),
+            WeightSpec("bo", (E,), dt, zero),
+        ]
+    return specs
+
+
+def _init_kv_state(attrs, input_specs):
+    import numpy as np
+
+    R = attrs["max_requests"]
+    S = attrs["max_seq_length"]
+    KH, D = attrs["num_kv_heads"], attrs["head_dim"]
+    cache_dtype = jnp.dtype(attrs.get("cache_dtype", "bfloat16"))
+    return {
+        "k_cache": jnp.zeros((R, S, KH, D), dtype=cache_dtype),
+        "v_cache": jnp.zeros((R, S, KH, D), dtype=cache_dtype),
+    }
+
+
+def _project_out(attrs, params, ctx, attn_out):
+    out = attn_out @ params["wo"]
+    if "bo" in params:
+        out = out + params["bo"]
+    return out
+
+
+@register_op_as(OpType.INC_MULTIHEAD_SELF_ATTENTION,
+                OpType.SPEC_INC_MULTIHEAD_SELF_ATTENTION)
+class IncMultiHeadSelfAttention(OpImpl):
+    """Incremental-decoding attention with per-slot KV cache.
+
+    The speculative (draft-model) variant is the same computation at
+    MAX_BEAM_WIDTH=1 (the reference default, batch_config.h:125); the draft
+    model simply owns its own cache state.
+    """
+
+    op_type = OpType.INC_MULTIHEAD_SELF_ATTENTION
+
+    @staticmethod
+    def infer_output_specs(attrs, input_specs):
+        (shape, d) = input_specs[0]
+        return [(tuple(shape[:-1]) + (attrs["embed_dim"],),
+                 attrs.get("data_type") or d)]
+
+    weight_specs = staticmethod(_weight_specs)
+    init_state = staticmethod(_init_kv_state)
+
+    @staticmethod
+    def forward(attrs, params, inputs, ctx):
+        x = inputs[0]
+        meta = ctx.batch_config
+        assert meta is not None, "serving ops need ctx.batch_config"
+        state = ctx.state_in[ctx.layer_name]
+        q, k, v = _qkv(attrs, params, x, ctx.compute_dtype)
+        if attrs.get("apply_rotary_embedding", False):
+            cos, sin = rotary_cos_sin(meta.positions, attrs["head_dim"],
+                                      attrs.get("rope_theta", 10000.0), q.dtype)
+            q = apply_rotary(q, cos, sin)
+            k = apply_rotary(k, cos, sin)
+        k_cache = append_kv(state["k_cache"], k, meta.start_pos,
+                            meta.num_tokens, meta.active)
+        v_cache = append_kv(state["v_cache"], v, meta.start_pos,
+                            meta.num_tokens, meta.active)
+        ctx.state_out[ctx.layer_name] = {"k_cache": k_cache, "v_cache": v_cache}
+        # Causal mask over absolute cache positions: query token i (at
+        # position start+i) sees cache[s] for s <= start+i.
+        S = k_cache.shape[1]
+        Q = x.shape[1]
+        key_pos = jnp.arange(S)[None, None, :]                     # [1,1,S]
+        q_abs = meta.start_pos[:, None] + jnp.arange(Q)[None, :]   # [R,Q]
+        key_mask = key_pos <= q_abs[:, :, None]                    # [R,Q,S]
+        out = _attend(attrs, q, k_cache, v_cache, key_mask, x.dtype,
+                      qpos=q_abs)
+        return [_project_out(attrs, params, ctx, out)]
+
+
+@register_op
+class TreeIncMultiHeadSelfAttention(OpImpl):
+    """Verification attention over a speculated token tree.
+
+    Reference tree_inc_multihead_self_attention.cu: tree-branch KV is staged
+    into the cache past the committed prefix (update_tree_branch_kv_cache
+    :110) and each tree node attends to the committed prefix plus its
+    ancestor chain. Accepted tokens are later compacted in place by
+    ``commit_tree_kv`` (the reference's commit_tokens_kernel :35).
+    """
+
+    op_type = OpType.TREE_INC_MULTIHEAD_SELF_ATTENTION
+
+    @staticmethod
+    def infer_output_specs(attrs, input_specs):
+        (shape, d) = input_specs[0]
+        return [(tuple(shape[:-1]) + (attrs["embed_dim"],),
+                 attrs.get("data_type") or d)]
+
+    weight_specs = staticmethod(_weight_specs)
+    init_state = staticmethod(_init_kv_state)
+
+    @staticmethod
+    def forward(attrs, params, inputs, ctx):
+        x = inputs[0]
+        meta = ctx.batch_config  # TreeBatchMeta (or BatchMeta for prefill)
+        if not hasattr(meta, "ancestor"):
+            # Prompt prefill reaches the verify model as a plain causal
+            # batch (a chain is a degenerate tree) — same as incremental.
+            return IncMultiHeadSelfAttention.forward(attrs, params, inputs, ctx)
+        state = ctx.state_in[ctx.layer_name]
+        q, k, v = _qkv(attrs, params, x, ctx.compute_dtype)
+        if attrs.get("apply_rotary_embedding", False):
+            cos, sin = rotary_cos_sin(meta.positions, attrs["head_dim"],
+                                      attrs.get("rope_theta", 10000.0), q.dtype)
+            q = apply_rotary(q, cos, sin)
+            k = apply_rotary(k, cos, sin)
+        # Stage tree KV at cache[start + node_idx] (node order is the
+        # flattened tree, so this is the same scatter as incremental append).
+        k_cache = append_kv(state["k_cache"], k, meta.start_pos,
+                            meta.num_nodes, meta.active)
+        v_cache = append_kv(state["v_cache"], v, meta.start_pos,
+                            meta.num_nodes, meta.active)
+        ctx.state_out[ctx.layer_name] = {"k_cache": k_cache, "v_cache": v_cache}
+        # Mask: committed prefix OR ancestor-or-self within the tree region.
+        S = k_cache.shape[1]
+        T = x.shape[1]
+        key_pos = jnp.arange(S)[None, None, :]
+        committed = key_pos < meta.start_pos[:, None, None]        # [R,1,S]
+        committed = jnp.broadcast_to(committed, (x.shape[0], T, S))
+        # ancestor[r, i, j] applies to cache position start_pos[r] + j.
+        node_of_key = jnp.arange(S)[None, :] - meta.start_pos[:, None]  # [R,S]
+        in_tree = (node_of_key >= 0) & (node_of_key < T)
+        node_idx = jnp.clip(node_of_key, 0, T - 1)
+        anc = jnp.take_along_axis(
+            meta.ancestor, node_idx[:, None, :].repeat(T, axis=1), axis=2)
+        key_mask = committed | (in_tree[:, None, :] & anc)
+        out = _attend(attrs, q, k_cache, v_cache, key_mask, x.dtype,
+                      qpos=meta.positions)
+        return [_project_out(attrs, params, ctx, out)]
+
+
+def commit_tree_kv(op_state: Dict[str, Any], src_node: jnp.ndarray,
+                   num_commit: jnp.ndarray, start_pos: jnp.ndarray,
+                   active: jnp.ndarray) -> Dict[str, Any]:
+    """Compact accepted tree nodes into the committed cache region.
+
+    For every KV-cache layer: cache[r, start+i] = cache[r, start+src_node[r,i]]
+    for i < num_commit[r]. src_node is the accepted path's node indices in
+    tree order (ascending, so in-place gather/scatter never overwrites a
+    yet-unread source: src_node[i] >= i always, and we gather first anyway).
+
+    Reference: commit_tokens_kernel (tree_inc_multihead_self_attention.cu:35)
+    driven by TreeVerifyBatchConfig::committed_tokens.
+    """
+
+    def commit_one(cache):
+        R = cache.shape[0]
+        S = cache.shape[1]
+        C = src_node.shape[1]
+        rows = jnp.arange(R)[:, None]
+        valid = (jnp.arange(C)[None, :] < num_commit[:, None]) & active[:, None]
+        src = start_pos[:, None] + src_node
+        src = jnp.clip(src, 0, S - 1)
+        moved = cache[rows, src]                                   # [R,C,KH,D]
+        dst = jnp.where(valid, start_pos[:, None] + jnp.arange(C)[None, :], S)
+        return cache.at[rows, dst].set(moved, mode="drop")
+
+    new_state = {}
+    for layer_name, st in op_state.items():
+        if isinstance(st, dict) and "k_cache" in st:
+            new_state[layer_name] = {
+                "k_cache": commit_one(st["k_cache"]),
+                "v_cache": commit_one(st["v_cache"]),
+            }
+        else:
+            new_state[layer_name] = st
+    return new_state

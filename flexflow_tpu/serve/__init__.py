@@ -1,0 +1,43 @@
+"""Serving stack: continuous batching, incremental decoding, speculative
+inference with token-tree verification.
+
+Capability parity with the reference serving runtime (reference
+src/runtime/request_manager.cc, inference_manager.cc, batch_config.cc and the
+{inc,spec_inc,tree_inc}_multihead_self_attention op family), re-designed for
+TPU/XLA: the per-step work is a single jitted SPMD program over static
+max-shapes instead of hundreds of dynamically launched Legion tasks, and the
+KV caches are functional arrays threaded through the step (donated, so XLA
+updates them in place).
+"""
+
+from flexflow_tpu.serve.batch_config import (
+    BatchMeta,
+    TreeBatchMeta,
+    GenerationConfig,
+    MAX_NUM_REQUESTS,
+    MAX_NUM_TOKENS,
+    MAX_BEAM_WIDTH,
+    MAX_BEAM_DEPTH,
+)
+from flexflow_tpu.serve.request_manager import (
+    Request,
+    RequestManager,
+    GenerationResult,
+    get_request_manager,
+)
+from flexflow_tpu.serve.inference_manager import InferenceManager
+
+__all__ = [
+    "BatchMeta",
+    "TreeBatchMeta",
+    "GenerationConfig",
+    "GenerationResult",
+    "InferenceManager",
+    "MAX_BEAM_DEPTH",
+    "MAX_BEAM_WIDTH",
+    "MAX_NUM_REQUESTS",
+    "MAX_NUM_TOKENS",
+    "Request",
+    "RequestManager",
+    "get_request_manager",
+]

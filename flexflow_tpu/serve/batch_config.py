@@ -1,0 +1,150 @@
+"""Batch descriptors for serving steps.
+
+Capability parity with the reference BatchConfig family (reference
+include/flexflow/batch_config.h: BatchConfig :39 with MAX_NUM_REQUESTS=64
+:57 / MAX_NUM_TOKENS=1024 :58, BeamSearchBatchConfig with MAX_BEAM_WIDTH=1
+:125 / MAX_BEAM_DEPTH=8 :126, TreeVerifyBatchConfig with committed_tokens
+:92-98), which are POD structs shipped by-value to every Legion task.
+
+TPU-first redesign: the reference flattens all in-flight tokens into one
+[MAX_NUM_TOKENS] list because Legion tasks are dynamically shaped. Under XLA
+everything must be static-shaped, so the batch is **request-slot major**:
+``tokens[max_requests, q]`` where ``q`` is the per-step token width (1 for
+incremental decoding, the prefill chunk for prompt processing, the tree size
+for verification). Each distinct ``q`` compiles one program; the scheduler
+buckets steps so there is no recompile storm. Inactive slots and padding
+positions are masked, never branched on — the step program is identical for
+every batch composition (the moral equivalent of the reference's Legion
+trace replay, request_manager.cc:1841-1856, is XLA's compiled-once step).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# Reference include/flexflow/batch_config.h:57-58
+MAX_NUM_REQUESTS = 64
+MAX_NUM_TOKENS = 1024
+# Reference include/flexflow/batch_config.h:125-126
+MAX_BEAM_WIDTH = 1
+MAX_BEAM_DEPTH = 8
+# Reference request_manager.cc:1829 (depth-4 in-flight batch pipeline)
+DEFAULT_PIPELINE_DEPTH = 4
+
+
+@dataclasses.dataclass
+class GenerationConfig:
+    """Sampling configuration (reference include/flexflow/inference.h:23-33)."""
+
+    do_sample: bool = False
+    temperature: float = 0.8
+    topp: float = 0.6
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BatchMeta:
+    """Per-step metadata, a pytree of device arrays (all static shapes).
+
+    tokens:    int32[R, Q]  token ids to run this step
+    positions: int32[R, Q]  absolute sequence position of each token
+    start_pos: int32[R]     KV-cache depth of each slot before this step
+    num_tokens:int32[R]     how many of the Q tokens are real (rest padding)
+    active:    bool[R]      slot currently holds a request
+    """
+
+    tokens: jnp.ndarray
+    positions: jnp.ndarray
+    start_pos: jnp.ndarray
+    num_tokens: jnp.ndarray
+    active: jnp.ndarray
+
+    @property
+    def q_width(self) -> int:
+        return self.tokens.shape[1]
+
+    @property
+    def max_requests(self) -> int:
+        return self.tokens.shape[0]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TreeBatchMeta:
+    """Verification-step metadata (reference TreeVerifyBatchConfig).
+
+    Queries are the nodes of a token tree, flattened per request slot. Node 0
+    is the root (the last committed token re-fed for its logits); node i's
+    parent is ``parent[r, i] < i``. Attention for node i sees the committed
+    prefix plus its own ancestor chain (the reference's causal tree mask,
+    tree_inc_multihead_self_attention.cu).
+
+    tokens:    int32[R, T]  tree node token ids
+    positions: int32[R, T]  absolute position = start_pos + depth_in_tree
+    parent:    int32[R, T]  parent node index within the tree (root: -1)
+    ancestor:  bool[R, T, T] ancestor[r, i, j] = node j is an ancestor of i
+                             (or j == i); computed host-side in numpy
+    start_pos: int32[R]     committed KV depth before this step
+    num_nodes: int32[R]     real tree nodes (rest padding)
+    active:    bool[R]
+    """
+
+    tokens: jnp.ndarray
+    positions: jnp.ndarray
+    parent: jnp.ndarray
+    ancestor: jnp.ndarray
+    start_pos: jnp.ndarray
+    num_nodes: jnp.ndarray
+    active: jnp.ndarray
+
+    @property
+    def q_width(self) -> int:
+        return self.tokens.shape[1]
+
+    @property
+    def max_requests(self) -> int:
+        return self.tokens.shape[0]
+
+
+def make_batch_meta(max_requests: int, q_width: int,
+                    tokens: Optional[np.ndarray] = None,
+                    positions: Optional[np.ndarray] = None,
+                    start_pos: Optional[np.ndarray] = None,
+                    num_tokens: Optional[np.ndarray] = None,
+                    active: Optional[np.ndarray] = None) -> BatchMeta:
+    """Host-side constructor with zero-filled defaults."""
+    R, Q = max_requests, q_width
+    z = lambda shape, dt: np.zeros(shape, dtype=dt)
+    return BatchMeta(
+        tokens=jnp.asarray(tokens if tokens is not None else z((R, Q), np.int32)),
+        positions=jnp.asarray(
+            positions if positions is not None else z((R, Q), np.int32)),
+        start_pos=jnp.asarray(
+            start_pos if start_pos is not None else z((R,), np.int32)),
+        num_tokens=jnp.asarray(
+            num_tokens if num_tokens is not None else z((R,), np.int32)),
+        active=jnp.asarray(active if active is not None else z((R,), bool)),
+    )
+
+
+def ancestor_mask_from_parents(parent: np.ndarray) -> np.ndarray:
+    """[R, T] parent indices -> [R, T, T] ancestor-or-self boolean mask.
+
+    Host-side numpy; T is small (<= speculation tree size), so the O(T^2)
+    walk is negligible next to a device step.
+    """
+    R, T = parent.shape
+    mask = np.zeros((R, T, T), dtype=bool)
+    for r in range(R):
+        for i in range(T):
+            j = i
+            while j >= 0:
+                mask[r, i, j] = True
+                j = parent[r, j]
+    return mask

@@ -1,0 +1,454 @@
+"""RequestManager: continuous batching + speculative-inference orchestration.
+
+Capability parity with the reference RequestManager (reference
+src/runtime/request_manager.cc, 1,953 LoC): register_new_request (tokenize +
+queue), prepare_next_batch{,_init,_beam,_verify} scheduling, the incremental
+generation loop (generate_incr_decoding :1810) and the speculative loop
+(generate_spec_infer :1867 — SSM beam expansion, merge_dfs_trees, LLM tree
+verification, token commit).
+
+TPU-first: the reference chains Legion futures so batches pipeline on GPUs;
+here each step is an async-dispatched jitted program (JAX dispatch returns
+before the TPU finishes, giving the same overlap), and the per-step batch
+descriptors are built host-side in numpy. Speculation state (per-SSM cache
+validity, token trees) lives in plain Python — only the step programs and the
+KV commit run on device.
+
+Slot/convention notes:
+* A request's ``tokens`` = prompt + generated. ``cache_depth`` counts tokens
+  whose KV is in a model's cache. The last token is always "pending" — it is
+  fed to produce the next token (matching the reference's per-request
+  ``token_start_offset``/depth bookkeeping, batch_config.h:66-75).
+* Single-chain speculation (one SSM, MAX_BEAM_WIDTH=1 — the reference
+  default) needs no KV commit at all: accepted drafts are already contiguous
+  in the verifier's cache. Multi-SSM token trees use ``commit_tree_kv``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+import jax
+
+from flexflow_tpu.serve.batch_config import (
+    BatchMeta,
+    TreeBatchMeta,
+    GenerationConfig,
+    MAX_BEAM_DEPTH,
+    ancestor_mask_from_parents,
+)
+from flexflow_tpu.serve.inference_manager import InferenceManager
+from flexflow_tpu.ops.inc_attention import commit_tree_kv
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request (reference request_manager.h Request)."""
+
+    guid: int
+    prompt_tokens: List[int]
+    max_new_tokens: int = 128
+    max_sequence_length: int = 0          # 0 -> model max_sequence_length
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    slot: int = -1
+    cache_depth: int = 0                  # verifier/incr cache depth
+    ssm_cache_depth: Dict[int, int] = dataclasses.field(default_factory=dict)
+    finished: bool = False
+
+    def __post_init__(self):
+        if not self.tokens:
+            self.tokens = list(self.prompt_tokens)
+
+    @property
+    def num_generated(self) -> int:
+        return len(self.tokens) - len(self.prompt_tokens)
+
+
+@dataclasses.dataclass
+class GenerationResult:
+    """Reference include/flexflow/inference.h GenerationResult."""
+
+    guid: int
+    input_tokens: List[int]
+    output_tokens: List[int]
+    input_text: str = ""
+    output_text: str = ""
+
+
+class RequestManager:
+    """Continuous-batching scheduler over request slots."""
+
+    _guid_counter = itertools.count(1000000)
+
+    def __init__(self, tokenizer=None, eos_token_id: Optional[int] = None,
+                 max_requests_per_batch: Optional[int] = None):
+        self.tokenizer = tokenizer
+        self.eos_token_id = eos_token_id
+        self.pending: deque = deque()
+        self.results: Dict[int, GenerationResult] = {}
+        self.max_spec_depth = MAX_BEAM_DEPTH
+        self._commit = jax.jit(commit_tree_kv, donate_argnums=(0,))
+
+    # -- registration (reference register_new_request, tokenization) -------
+    def register_tokenizer(self, tokenizer, eos_token_id=None):
+        self.tokenizer = tokenizer
+        if eos_token_id is None:
+            eos_token_id = getattr(tokenizer, "eos_token_id", None)
+        self.eos_token_id = eos_token_id
+
+    def register_new_request(self, prompt: Union[str, Sequence[int]],
+                             max_new_tokens: int = 128,
+                             max_sequence_length: int = 0) -> int:
+        if isinstance(prompt, str):
+            assert self.tokenizer is not None, "string prompts need a tokenizer"
+            toks = list(self.tokenizer.encode(prompt))
+        else:
+            toks = list(int(t) for t in prompt)
+        assert toks, "empty prompt"
+        guid = next(self._guid_counter)
+        self.pending.append(Request(guid=guid, prompt_tokens=toks,
+                                    max_new_tokens=max_new_tokens,
+                                    max_sequence_length=max_sequence_length))
+        return guid
+
+    # -- scheduling helpers ------------------------------------------------
+    def _finish_if_done(self, req: Request, max_seq: int) -> bool:
+        limit = min(req.max_sequence_length or max_seq, max_seq)
+        if len(req.tokens) > limit:
+            req.tokens = req.tokens[:limit]
+        if (req.num_generated >= req.max_new_tokens
+                or len(req.tokens) >= limit
+                or (self.eos_token_id is not None and req.num_generated > 0
+                    and req.tokens[-1] == self.eos_token_id)):
+            req.finished = True
+        return req.finished
+
+    def _collect(self, req: Request) -> GenerationResult:
+        out = req.tokens[len(req.prompt_tokens):]
+        res = GenerationResult(guid=req.guid,
+                               input_tokens=list(req.prompt_tokens),
+                               output_tokens=out)
+        if self.tokenizer is not None:
+            try:
+                res.input_text = self.tokenizer.decode(res.input_tokens)
+                res.output_text = self.tokenizer.decode(out)
+            except Exception:
+                pass
+        self.results[req.guid] = res
+        return res
+
+    def _fill_slots(self, active: List[Optional[Request]], max_seq: int,
+                    done: List[GenerationResult]):
+        for slot in range(len(active)):
+            while active[slot] is None and self.pending:
+                req = self.pending.popleft()
+                limit = min(req.max_sequence_length or max_seq, max_seq)
+                if len(req.prompt_tokens) >= limit:
+                    # no room to generate even one token (reference
+                    # RequestManager rejects over-long prompts up front)
+                    req.finished = True
+                    done.append(self._collect(req))
+                    continue
+                req.slot = slot
+                active[slot] = req
+
+    # -- batch assembly ----------------------------------------------------
+    @staticmethod
+    def _meta_from_rows(R: int, Q: int, rows) -> BatchMeta:
+        """rows: list of (slot, tokens_chunk, start_pos)."""
+        tokens = np.zeros((R, Q), np.int32)
+        positions = np.zeros((R, Q), np.int32)
+        start = np.zeros((R,), np.int32)
+        num = np.zeros((R,), np.int32)
+        act = np.zeros((R,), bool)
+        for slot, chunk, sp in rows:
+            n = len(chunk)
+            tokens[slot, :n] = chunk
+            positions[slot, :n] = np.arange(sp, sp + n)
+            start[slot] = sp
+            num[slot] = n
+            act[slot] = True
+        return BatchMeta(tokens=tokens, positions=positions, start_pos=start,
+                         num_tokens=num, active=act)
+
+    def _prefill_rows(self, active, chunk: int, depth_of, max_batch_tokens):
+        """Slots whose pending tokens exceed 1 → next chunk each (leaving at
+        least one token pending so the final chunk emits the next token)."""
+        rows, budget = [], max_batch_tokens
+        for req in active:
+            if req is None or req.finished:
+                continue
+            d = depth_of(req)
+            npend = len(req.tokens) - d
+            if npend > 1:
+                take = min(npend - 1, chunk, budget)
+                if take <= 0:
+                    continue
+                rows.append((req.slot, req.tokens[d:d + take], d))
+                budget -= take
+        return rows
+
+    # =====================================================================
+    # Incremental decoding (reference generate_incr_decoding :1810)
+    # =====================================================================
+    def generate_incr_decoding(self, model) -> List[GenerationResult]:
+        ifm = getattr(model, "_inference_manager", None)
+        if ifm is None:
+            ifm = model._inference_manager = InferenceManager(model)
+        cfg = model.config
+        R = cfg.max_requests_per_batch
+        max_seq = cfg.max_sequence_length
+        chunk = max(1, cfg.max_tokens_per_batch // max(1, min(R, 4)))
+        active: List[Optional[Request]] = [None] * R
+        done: List[GenerationResult] = []
+
+        while self.pending or any(a is not None for a in active):
+            self._fill_slots(active, max_seq, done)
+            rows = self._prefill_rows(active, chunk,
+                                      lambda r: r.cache_depth,
+                                      cfg.max_tokens_per_batch)
+            if rows:
+                meta = self._meta_from_rows(R, chunk, rows)
+                ifm.step(meta)   # outputs at non-final chunks are ignored
+                for slot, chunk_toks, sp in rows:
+                    active[slot].cache_depth = sp + len(chunk_toks)
+                continue
+            # decode step: every unfinished slot feeds its pending token
+            rows = [(req.slot, req.tokens[-1:], len(req.tokens) - 1)
+                    for req in active if req is not None and not req.finished]
+            if rows:
+                meta = self._meta_from_rows(R, 1, rows)
+                out = ifm.step(meta)                       # [R, 1] token ids
+                for slot, _toks, sp in rows:
+                    req = active[slot]
+                    req.tokens.append(int(out[slot, 0]))
+                    req.cache_depth = sp + 1
+                    self._finish_if_done(req, max_seq)
+            for slot in range(R):
+                req = active[slot]
+                if req is not None and req.finished:
+                    done.append(self._collect(req))
+                    active[slot] = None
+        return done
+
+    # =====================================================================
+    # Speculative inference (reference generate_spec_infer :1867)
+    # =====================================================================
+    def generate_spec_infer(self, llm, ssms: List[Any],
+                            spec_depth: Optional[int] = None
+                            ) -> List[GenerationResult]:
+        """LLM verifies token trees proposed by draft SSMs.
+
+        Each SSM proposes a depth-``spec_depth`` greedy chain per request;
+        chains are merged into one token tree (shared prefixes dedup — the
+        reference's merge_dfs_trees, request_manager.cc); the LLM scores all
+        tree nodes in one step; the longest root path whose every child
+        matches the verifier's argmax is accepted, plus one bonus token.
+        """
+        llm_ifm = getattr(llm, "_inference_manager", None)
+        if llm_ifm is None:
+            llm_ifm = llm._inference_manager = InferenceManager(llm)
+        ssm_ifms = []
+        for ssm in ssms:
+            m = getattr(ssm, "_inference_manager", None)
+            if m is None:
+                m = ssm._inference_manager = InferenceManager(ssm)
+            ssm_ifms.append(m)
+        cfg = llm.config
+        R = cfg.max_requests_per_batch
+        max_seq = cfg.max_sequence_length
+        depth = min(spec_depth or self.max_spec_depth, self.max_spec_depth)
+        chunk = max(1, cfg.max_tokens_per_batch // max(1, min(R, 4)))
+        # tree capacity: root + depth nodes per ssm
+        T = 1 + depth * len(ssms)
+        active: List[Optional[Request]] = [None] * R
+        done: List[GenerationResult] = []
+
+        def ssm_depth_of(i):
+            return lambda r: r.ssm_cache_depth.get(i, 0)
+
+        while self.pending or any(a is not None for a in active):
+            self._fill_slots(active, max_seq, done)
+            # ---- prompt prefill: verifier + every SSM ----
+            prefilled = False
+            rows = self._prefill_rows(active, chunk, lambda r: r.cache_depth,
+                                      cfg.max_tokens_per_batch)
+            if rows:
+                meta = self._meta_from_rows(R, chunk, rows)
+                llm_ifm.step(meta)
+                for slot, toks, sp in rows:
+                    active[slot].cache_depth = sp + len(toks)
+                prefilled = True
+            for i, ifm in enumerate(ssm_ifms):
+                rows = self._prefill_rows(active, chunk, ssm_depth_of(i),
+                                          cfg.max_tokens_per_batch)
+                if rows:
+                    meta = self._meta_from_rows(R, chunk, rows)
+                    ifm.step(meta)
+                    for slot, toks, sp in rows:
+                        active[slot].ssm_cache_depth[i] = sp + len(toks)
+                    prefilled = True
+            if prefilled:
+                continue
+            live = [req for req in active if req is not None and not req.finished]
+            if live:
+                # ---- draft phase: each SSM decodes a greedy chain ----
+                chains: List[Dict[int, List[int]]] = []  # per ssm: slot->toks
+                for i, ifm in enumerate(ssm_ifms):
+                    chains.append(self._draft_chains(ifm, i, live, R, depth))
+                # clamp speculation so tree positions never pass the KV cache
+                # end / the request's length limit
+                for req in live:
+                    limit = min(req.max_sequence_length or max_seq, max_seq)
+                    room = max(0, limit - len(req.tokens) - 1)
+                    if room < depth:
+                        for c in chains:
+                            if req.slot in c:
+                                c[req.slot] = c[req.slot][:room]
+                # ---- merge chains into token trees ----
+                trees = {}
+                for req in live:
+                    node_tok, node_parent = [req.tokens[-1]], [-1]
+                    for c in chains:
+                        cur = 0
+                        for t in c.get(req.slot, []):
+                            child = next((j for j in range(len(node_tok))
+                                          if node_parent[j] == cur
+                                          and node_tok[j] == t), None)
+                            if child is None:
+                                node_tok.append(t)
+                                node_parent.append(cur)
+                                child = len(node_tok) - 1
+                            cur = child
+                    trees[req.slot] = (node_tok, node_parent)
+                # ---- verify on the LLM ----
+                self._verify_and_commit(llm, llm_ifm, live, trees, R, T,
+                                        max_seq, depth)
+            for slot in range(R):
+                req = active[slot]
+                if req is not None and req.finished:
+                    done.append(self._collect(req))
+                    active[slot] = None
+        return done
+
+    def _draft_chains(self, ifm, ssm_idx, live, R, depth):
+        """Greedy depth-``depth`` chain per live request on one SSM.
+
+        Every step is a width-1 decode: the prefill loop has already caught
+        each SSM's cache up to exactly one pending token (after a divergent
+        acceptance the missing committed tokens go through the prefill
+        program like any other prompt chunk).
+        """
+        rows = []
+        for req in live:
+            d = req.ssm_cache_depth.get(ssm_idx, 0)
+            assert d == len(req.tokens) - 1, (d, len(req.tokens))
+            rows.append((req.slot, req.tokens[-1:], d))
+        meta = self._meta_from_rows(R, 1, rows)
+        out = ifm.step(meta)
+        chains = {}
+        last = {}
+        for req, (slot, catch, d) in zip(live, rows):
+            tok = int(out[slot, 0])
+            chains[slot] = [tok]
+            last[slot] = tok
+            # cache now holds everything incl. the last committed token
+            req.ssm_cache_depth[ssm_idx] = d + 1
+        for _ in range(depth - 1):
+            rows = [(req.slot, [last[req.slot]],
+                     req.ssm_cache_depth[ssm_idx]) for req in live]
+            meta = self._meta_from_rows(R, 1, rows)
+            out = ifm.step(meta)
+            for req in live:
+                req.ssm_cache_depth[ssm_idx] += 1
+                tok = int(out[req.slot, 0])
+                chains[req.slot].append(tok)
+                last[req.slot] = tok
+        # drafted tokens beyond the committed prefix are tentative: cache
+        # entries past the accepted point are overwritten next round, so we
+        # rewind the bookkeeping to the committed depth after drafting
+        for req in live:
+            req.ssm_cache_depth[ssm_idx] -= (depth - 1)
+        return chains
+
+    def _verify_and_commit(self, llm, ifm, live, trees, R, T, max_seq, depth):
+        tokens = np.zeros((R, T), np.int32)
+        positions = np.zeros((R, T), np.int32)
+        parent = np.full((R, T), -1, np.int32)
+        start = np.zeros((R,), np.int32)
+        num = np.zeros((R,), np.int32)
+        act = np.zeros((R,), bool)
+        node_depth = np.zeros((R, T), np.int32)
+        for req in live:
+            ntok, npar = trees[req.slot]
+            n = len(ntok)
+            sp = len(req.tokens) - 1
+            assert req.cache_depth == sp, (req.cache_depth, sp)
+            tokens[req.slot, :n] = ntok
+            parent[req.slot, :n] = npar
+            for j in range(1, n):
+                node_depth[req.slot, j] = node_depth[req.slot, npar[j]] + 1
+            positions[req.slot, :n] = sp + node_depth[req.slot, :n]
+            start[req.slot] = sp
+            num[req.slot] = n
+            act[req.slot] = True
+        anc = ancestor_mask_from_parents(parent)
+        meta = TreeBatchMeta(tokens=tokens, positions=positions,
+                             parent=parent, ancestor=anc, start_pos=start,
+                             num_nodes=num, active=act)
+        out = ifm.step(meta)                               # [R, T] argmax ids
+        # ---- greedy acceptance walk ----
+        src_node = np.zeros((R, self.max_spec_depth + 1), np.int32)
+        ncommit = np.zeros((R,), np.int32)
+        needs_commit = False
+        for req in live:
+            ntok, npar = trees[req.slot]
+            n = len(ntok)
+            cur, path = 0, []
+            while True:
+                want = int(out[req.slot, cur])
+                child = next((j for j in range(cur + 1, n)
+                              if npar[j] == cur and ntok[j] == want), None)
+                if child is None:
+                    break
+                path.append(child)
+                cur = child
+            bonus = int(out[req.slot, cur])
+            accepted = [ntok[j] for j in path]
+            # verifier cache: path nodes must land at start+1..start+k
+            if path != list(range(1, len(path) + 1)):
+                needs_commit = True
+            src_node[req.slot, :len(path)] = [j - 1 for j in path]
+            ncommit[req.slot] = len(path)
+            # trim the accepted chunk at EOS / max_new_tokens before it is
+            # appended — incremental decoding would have stopped there
+            new_toks = accepted + [bonus]
+            room = req.max_new_tokens - req.num_generated
+            new_toks = new_toks[:max(0, room)]
+            if self.eos_token_id is not None and self.eos_token_id in new_toks:
+                new_toks = new_toks[:new_toks.index(self.eos_token_id) + 1]
+            req.tokens.extend(new_toks)
+            req.cache_depth = min(start[req.slot] + 1 + len(path),
+                                  len(req.tokens) - 1)
+            self._finish_if_done(req, max_seq)
+        if needs_commit:
+            llm.op_state = self._commit(
+                llm.op_state, jax.numpy.asarray(src_node),
+                jax.numpy.asarray(ncommit), jax.numpy.asarray(start + 1),
+                jax.numpy.asarray(act))
+
+
+_request_manager: Optional[RequestManager] = None
+
+
+def get_request_manager() -> RequestManager:
+    """Singleton accessor (reference RequestManager::get_request_manager)."""
+    global _request_manager
+    if _request_manager is None:
+        _request_manager = RequestManager()
+    return _request_manager

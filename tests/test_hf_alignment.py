@@ -1,0 +1,120 @@
+"""Alignment vs HuggingFace transformers — the serving correctness oracle.
+
+Reference test strategy (reference tests/inference/huggingface_inference.py
+and tests/align/): run the same model in FlexFlow and in HF/torch on CPU and
+assert matching outputs. Here: a tiny randomly-initialized HF LLaMA's weights
+load into our LLaMA graph and greedy decoding must be token-identical.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import flexflow_tpu as ff
+from flexflow_tpu.ffconst import InferenceMode
+from flexflow_tpu.models.llama import (LLAMAConfig, create_llama_model,
+                                       hf_weight_map)
+from flexflow_tpu.models.hf_utils import load_hf_state_dict
+from flexflow_tpu.serve.request_manager import RequestManager
+
+
+@pytest.fixture(scope="module")
+def hf_model():
+    cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False)
+    torch.manual_seed(0)
+    m = transformers.LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def build_ff_from_hf(hf_model, max_requests=2, max_seq=64):
+    config = LLAMAConfig.from_hf_config(hf_model.config)
+    ffc = ff.FFConfig(max_requests_per_batch=max_requests,
+                      max_sequence_length=max_seq, max_tokens_per_batch=16,
+                      kv_cache_dtype="float32")
+    model = ff.FFModel(ffc)
+    create_llama_model(model, config)
+    model.compile(comp_mode=ff.CompMode.COMP_MODE_INFERENCE)
+    n = load_hf_state_dict(model, hf_model.state_dict(),
+                           hf_weight_map(config))
+    assert n == len(hf_weight_map(config))
+    return model
+
+
+def test_greedy_decode_matches_hf(hf_model):
+    prompt = [3, 17, 42, 99, 7]
+    new_tokens = 10
+    with torch.no_grad():
+        out = hf_model.generate(
+            torch.tensor([prompt]), max_new_tokens=new_tokens, do_sample=False,
+            pad_token_id=0)
+    hf_tokens = out[0, len(prompt):].tolist()
+
+    model = build_ff_from_hf(hf_model)
+    rm = RequestManager()
+    rm.register_new_request(prompt, max_new_tokens=new_tokens)
+    (res,) = rm.generate_incr_decoding(model)
+    assert res.output_tokens == hf_tokens
+
+
+def test_prefill_logits_close_to_hf(hf_model):
+    """Direct logits comparison on the full prompt (fp32 CPU both sides)."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu.ops.base import OpContext
+    from flexflow_tpu.serve.batch_config import make_batch_meta
+
+    prompt = [3, 17, 42, 99, 7, 55]
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor([prompt])).logits[0].numpy()
+
+    model = build_ff_from_hf(hf_model)
+    R, Q = model.config.max_requests_per_batch, len(prompt)
+    tokens = np.zeros((R, Q), np.int32)
+    tokens[0] = prompt
+    meta = make_batch_meta(
+        R, Q, tokens=tokens,
+        positions=np.broadcast_to(np.arange(Q, dtype=np.int32), (R, Q)).copy(),
+        num_tokens=np.array([Q] + [0] * (R - 1), np.int32),
+        active=np.array([True] + [False] * (R - 1)))
+    ctx = OpContext(training=False, compute_dtype=jnp.float32,
+                    batch_config=meta, config=model.config)
+    feeds = {model.input_tensors[0].tensor_id: meta.tokens}
+    values, _ = model._run_graph(model.params, feeds, ctx, model.op_state)
+    # logits tensor = input of the final argmax layer
+    logits_t = model.layers[-1].inputs[0]
+    ours = np.asarray(values[logits_t.tensor_id])[0]
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_spec_infer_matches_hf(hf_model):
+    prompt = [3, 17, 42, 99, 7]
+    new_tokens = 10
+    with torch.no_grad():
+        out = hf_model.generate(
+            torch.tensor([prompt]), max_new_tokens=new_tokens, do_sample=False,
+            pad_token_id=0)
+    hf_tokens = out[0, len(prompt):].tolist()
+
+    config = LLAMAConfig.from_hf_config(hf_model.config)
+    ffc = ff.FFConfig(max_requests_per_batch=2, max_sequence_length=64,
+                      max_tokens_per_batch=16, kv_cache_dtype="float32")
+    llm = ff.FFModel(ffc)
+    create_llama_model(llm, config, mode=InferenceMode.TREE_VERIFY_MODE)
+    llm.compile(comp_mode=ff.CompMode.COMP_MODE_INFERENCE)
+    load_hf_state_dict(llm, hf_model.state_dict(), hf_weight_map(config))
+    ssm = ff.FFModel(ffc)
+    create_llama_model(ssm, config, mode=InferenceMode.BEAM_SEARCH_MODE)
+    ssm.compile(comp_mode=ff.CompMode.COMP_MODE_INFERENCE)
+    load_hf_state_dict(ssm, hf_model.state_dict(), hf_weight_map(config))
+
+    rm = RequestManager()
+    rm.register_new_request(prompt, max_new_tokens=new_tokens)
+    (res,) = rm.generate_spec_infer(llm, [ssm], spec_depth=4)
+    assert res.output_tokens[:new_tokens] == hf_tokens

@@ -1,0 +1,175 @@
+"""Serving-stack tests: incremental decoding, continuous batching, and
+speculative inference with tree verification.
+
+Test strategy follows the reference CI matrix (reference
+tests/inference/python_inference_tests.sh): (a) incremental decoding is
+deterministic, (b) spec-infer output must token-match incremental decoding
+(check_partial_token_match :29), (c) batching must not change results.
+"""
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.ffconst import InferenceMode
+from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+from flexflow_tpu.serve.request_manager import RequestManager
+
+TINY = LLAMAConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                   num_hidden_layers=2, num_attention_heads=4,
+                   num_key_value_heads=2, max_position_embeddings=128)
+
+
+def make_model(mode=InferenceMode.INC_DECODING_MODE, seed=0, max_requests=4,
+               max_seq=64):
+    cfg = ff.FFConfig(max_requests_per_batch=max_requests,
+                      max_sequence_length=max_seq, max_tokens_per_batch=16,
+                      seed=seed, kv_cache_dtype="float32")
+    model = ff.FFModel(cfg)
+    create_llama_model(model, TINY, mode=mode)
+    model.compile(comp_mode=ff.CompMode.COMP_MODE_INFERENCE)
+    return model
+
+
+def test_incr_decoding_deterministic():
+    model = make_model()
+    rm = RequestManager()
+    prompts = [[5, 9, 23, 44], [7, 3]]
+    for p in prompts:
+        rm.register_new_request(p, max_new_tokens=8)
+    results = rm.generate_incr_decoding(model)
+    assert len(results) == 2
+    by_input = {tuple(r.input_tokens): r for r in results}
+    for p in prompts:
+        r = by_input[tuple(p)]
+        assert len(r.output_tokens) == 8
+        assert all(0 <= t < TINY.vocab_size for t in r.output_tokens)
+    # decoding again from scratch gives identical output
+    rm2 = RequestManager()
+    model2 = make_model()
+    for p in prompts:
+        rm2.register_new_request(p, max_new_tokens=8)
+    results2 = rm2.generate_incr_decoding(model2)
+    for r2 in results2:
+        assert by_input[tuple(r2.input_tokens)].output_tokens == r2.output_tokens
+
+
+def test_continuous_batching_more_requests_than_slots():
+    model = make_model(max_requests=2)
+    rm = RequestManager()
+    prompts = [[i + 1, i + 2, i + 3] for i in range(5)]
+    for p in prompts:
+        rm.register_new_request(p, max_new_tokens=4)
+    results = rm.generate_incr_decoding(model)
+    assert len(results) == 5
+    # each request's result matches a solo run
+    solo_model = make_model(max_requests=2)
+    for p, r in zip(prompts, sorted(results, key=lambda r: r.guid)):
+        rm_solo = RequestManager()
+        rm_solo.register_new_request(p, max_new_tokens=4)
+        solo = rm_solo.generate_incr_decoding(solo_model)[0]
+        assert solo.output_tokens == r.output_tokens, p
+
+
+def test_prefill_longer_than_chunk():
+    model = make_model()
+    rm = RequestManager()
+    prompt = list(np.random.RandomState(0).randint(1, 100, size=37))
+    rm.register_new_request([int(t) for t in prompt], max_new_tokens=4)
+    (res,) = rm.generate_incr_decoding(model)
+    assert len(res.output_tokens) == 4
+
+
+def test_max_sequence_length_respected():
+    model = make_model(max_seq=16)
+    rm = RequestManager()
+    rm.register_new_request([1, 2, 3], max_new_tokens=100)
+    (res,) = rm.generate_incr_decoding(model)
+    assert len(res.input_tokens) + len(res.output_tokens) <= 16
+
+
+def test_spec_infer_matches_incr_decoding():
+    """With the SSM = the LLM's own weights, speculation must accept nearly
+    everything and the output must be token-identical to incremental
+    decoding (the reference CI gate, python_inference_tests.sh:29)."""
+    prompts = [[5, 9, 23, 44], [7, 3, 11]]
+    incr_model = make_model(seed=0)
+    rm = RequestManager()
+    for p in prompts:
+        rm.register_new_request(p, max_new_tokens=12)
+    incr = {tuple(r.input_tokens): r.output_tokens
+            for r in rm.generate_incr_decoding(incr_model)}
+
+    llm = make_model(mode=InferenceMode.TREE_VERIFY_MODE, seed=0)
+    ssm = make_model(mode=InferenceMode.BEAM_SEARCH_MODE, seed=0)
+    rm2 = RequestManager()
+    for p in prompts:
+        rm2.register_new_request(p, max_new_tokens=12)
+    spec = rm2.generate_spec_infer(llm, [ssm], spec_depth=4)
+    assert len(spec) == 2
+    for r in spec:
+        assert incr[tuple(r.input_tokens)][:12] == r.output_tokens[:12]
+
+
+def test_spec_infer_divergent_ssm_still_correct():
+    """A different-weight SSM proposes mostly-wrong drafts; the verifier must
+    still emit exactly the incremental-decoding tokens."""
+    prompts = [[5, 9, 23, 44]]
+    incr_model = make_model(seed=0)
+    rm = RequestManager()
+    for p in prompts:
+        rm.register_new_request(p, max_new_tokens=10)
+    incr = {tuple(r.input_tokens): r.output_tokens
+            for r in rm.generate_incr_decoding(incr_model)}
+
+    llm = make_model(mode=InferenceMode.TREE_VERIFY_MODE, seed=0)
+    ssm = make_model(mode=InferenceMode.BEAM_SEARCH_MODE, seed=123)
+    rm2 = RequestManager()
+    for p in prompts:
+        rm2.register_new_request(p, max_new_tokens=10)
+    spec = rm2.generate_spec_infer(llm, [ssm], spec_depth=4)
+    for r in spec:
+        assert incr[tuple(r.input_tokens)][:10] == r.output_tokens[:10]
+
+
+def test_spec_infer_eos_and_budget_respected():
+    """EOS accepted mid-chunk must stop generation exactly there, and the
+    output must never exceed max_new_tokens (matching incremental)."""
+    incr_model = make_model(seed=0)
+    rm = RequestManager()
+    rm.register_new_request([5, 9, 23, 44], max_new_tokens=7)
+    (incr,) = rm.generate_incr_decoding(incr_model)
+    # pick an EOS id that actually appears in the incremental output
+    eos = incr.output_tokens[3]
+    stop_at = incr.output_tokens.index(eos) + 1
+
+    llm = make_model(mode=InferenceMode.TREE_VERIFY_MODE, seed=0)
+    ssm = make_model(mode=InferenceMode.BEAM_SEARCH_MODE, seed=0)
+    rm2 = RequestManager(eos_token_id=eos)
+    rm2.register_new_request([5, 9, 23, 44], max_new_tokens=7)
+    (spec,) = rm2.generate_spec_infer(llm, [ssm], spec_depth=4)
+    assert len(spec.output_tokens) == stop_at
+    assert spec.output_tokens == incr.output_tokens[:stop_at]
+    assert len(spec.output_tokens) <= 7
+
+
+def test_spec_infer_multi_ssm_tree():
+    """Two different SSMs -> a genuine token tree (shared-root chains) and a
+    commit path; output must still match incremental decoding."""
+    prompts = [[5, 9, 23, 44], [2, 8]]
+    incr_model = make_model(seed=0)
+    rm = RequestManager()
+    for p in prompts:
+        rm.register_new_request(p, max_new_tokens=10)
+    incr = {tuple(r.input_tokens): r.output_tokens
+            for r in rm.generate_incr_decoding(incr_model)}
+
+    llm = make_model(mode=InferenceMode.TREE_VERIFY_MODE, seed=0)
+    ssm1 = make_model(mode=InferenceMode.BEAM_SEARCH_MODE, seed=0)
+    ssm2 = make_model(mode=InferenceMode.BEAM_SEARCH_MODE, seed=7)
+    rm2 = RequestManager()
+    for p in prompts:
+        rm2.register_new_request(p, max_new_tokens=10)
+    spec = rm2.generate_spec_infer(llm, [ssm1, ssm2], spec_depth=3)
+    for r in spec:
+        assert incr[tuple(r.input_tokens)][:10] == r.output_tokens[:10]
