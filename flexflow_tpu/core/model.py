@@ -71,6 +71,11 @@ class FFModel:
         self._cached_grads = None
         self._pending_batch = None
         self._layer_name_counts: Dict[str, int] = {}
+        # Serving position input (models with learned positional embeddings:
+        # OPT, StarCoder). Reference FFModel::set_position_offset + the
+        # position_input tensor created by those model builders.
+        self.position_input_tensor: Optional[Tensor] = None
+        self.position_offset: int = 0
 
     # ==================================================================
     # Tensor / layer creation
@@ -81,6 +86,17 @@ class FFModel:
                    model=self)
         self.input_tensors.append(t)
         return t
+
+    def create_position_tensor(self, dims: Sequence[int]) -> Tensor:
+        """Input tensor fed with absolute token positions (+ offset) by the
+        InferenceManager each step (reference RM_LOAD_POSITION task)."""
+        t = self.create_tensor(dims, DataType.DT_INT32, name="position_input")
+        self.position_input_tensor = t
+        return t
+
+    def set_position_offset(self, offset: int):
+        """Reference FFModel::set_position_offset (OPT feeds positions+2)."""
+        self.position_offset = offset
 
     def _add_layer(self, op_type: OpType, inputs: List[Tensor],
                    attrs: Dict[str, Any], name: Optional[str] = None
@@ -349,8 +365,11 @@ class FFModel:
     def elu(self, x, name=None):
         return self._add_layer(OpType.ELU, [x], {}, name)
 
-    def gelu(self, x, name=None):
-        return self._add_layer(OpType.GELU, [x], {}, name)
+    def gelu(self, x, approximate: bool = False, name=None):
+        """Exact (erf) by default — HF torch.nn.GELU parity; tanh form via
+        approximate=True (gelu_pytorch_tanh, used by StarCoder)."""
+        return self._add_layer(OpType.GELU, [x],
+                               dict(approximate=approximate), name)
 
     def identity(self, x, name=None):
         return self._add_layer(OpType.IDENTITY, [x], {}, name)

@@ -1,0 +1,199 @@
+"""Falcon decoder for serving.
+
+Capability parity with the reference Falcon builder (reference
+inference/models/falcon.cc create_falcon_model and
+python/flexflow/serve/models/falcon.py): rotary multi-query/grouped-query
+attention (n_head_kv, reference falcon.cc:99-162), parallel attention+MLP
+block with a shared input layernorm (the 7B architecture the reference
+serves), GELU MLP without biases, tied lm_head. Additionally supports the
+"new decoder architecture" (40B-style separate ln_attn/ln_mlp) and the
+sequential non-parallel block, which the HF oracle exposes via config flags.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from flexflow_tpu.ffconst import DataType, InferenceMode
+from flexflow_tpu.models.hf_utils import _to_numpy, tie_lm_head
+from flexflow_tpu.serve.batch_config import GenerationConfig
+
+
+@dataclasses.dataclass
+class FalconConfig:
+    vocab_size: int = 65024
+    hidden_size: int = 4544
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 71
+    num_kv_heads: int = 1
+    layer_norm_epsilon: float = 1e-5
+    rope_theta: float = 10000.0
+    bias: bool = False
+    parallel_attn: bool = True
+    new_decoder_architecture: bool = False
+
+    @classmethod
+    def from_hf_config(cls, hf) -> "FalconConfig":
+        get = (lambda k, d=None: getattr(hf, k, d)) if not isinstance(hf, dict) \
+            else (lambda k, d=None: hf.get(k, d))
+        n_head = get("num_attention_heads") or get("n_head", 71)
+        new_arch = get("new_decoder_architecture", False)
+        multi_query = get("multi_query", True)
+        if new_arch or not multi_query:
+            n_kv = get("num_kv_heads") or get("n_head_kv") or n_head
+        else:
+            n_kv = 1
+        return cls(
+            vocab_size=get("vocab_size", 65024),
+            hidden_size=get("hidden_size", 4544),
+            num_hidden_layers=get("num_hidden_layers") or get("n_layer", 32),
+            num_attention_heads=n_head,
+            num_kv_heads=n_kv,
+            layer_norm_epsilon=get("layer_norm_epsilon", 1e-5),
+            rope_theta=get("rope_theta", 10000.0),
+            bias=get("bias", False),
+            parallel_attn=get("parallel_attn", True),
+            new_decoder_architecture=new_arch,
+        )
+
+
+def create_falcon_model(model, config: FalconConfig,
+                        mode: InferenceMode = InferenceMode.INC_DECODING_MODE,
+                        generation_config: Optional[GenerationConfig] = None,
+                        data_type: DataType = DataType.DT_FLOAT):
+    """Record the Falcon decoder graph into ``model`` (an FFModel)."""
+    c = config
+    R = model.config.max_requests_per_batch
+    tokens = model.create_tensor([R, 1], DataType.DT_INT32)
+    h = model.embedding(tokens, c.vocab_size, c.hidden_size,
+                        dtype=data_type, name="word_embeddings")
+
+    if mode == InferenceMode.TREE_VERIFY_MODE:
+        attn_builder = model.tree_inc_multiquery_self_attention
+    elif mode == InferenceMode.BEAM_SEARCH_MODE:
+        attn_builder = model.spec_inc_multiquery_self_attention
+    else:
+        attn_builder = model.inc_multiquery_self_attention
+
+    def ln(x, name):
+        return model.layer_norm(x, axes=[-1], eps=c.layer_norm_epsilon,
+                                use_bias=True, name=name)
+
+    for i in range(c.num_hidden_layers):
+        if c.new_decoder_architecture:
+            attn_in = ln(h, f"h.{i}.ln_attn")
+            mlp_in = ln(h, f"h.{i}.ln_mlp")
+        else:
+            attn_in = ln(h, f"h.{i}.input_layernorm")
+            mlp_in = attn_in if c.parallel_attn else None
+        attn = attn_builder(
+            attn_in, c.hidden_size, c.num_attention_heads, c.num_kv_heads,
+            data_type=data_type, bias=c.bias, apply_rotary_embedding=True,
+            rope_theta=c.rope_theta, name=f"h.{i}.self_attention")
+        if mlp_in is None:  # sequential (non-parallel) block
+            h = model.add(h, attn)
+            mlp_in = ln(h, f"h.{i}.post_attention_layernorm")
+            up = model.dense(mlp_in, 4 * c.hidden_size, use_bias=c.bias,
+                             datatype=data_type,
+                             name=f"h.{i}.mlp.dense_h_to_4h")
+            act = model.gelu(up)
+            down = model.dense(act, c.hidden_size, use_bias=c.bias,
+                               datatype=data_type,
+                               name=f"h.{i}.mlp.dense_4h_to_h")
+            h = model.add(h, down)
+        else:  # parallel attention + MLP: out = h + attn + mlp
+            up = model.dense(mlp_in, 4 * c.hidden_size, use_bias=c.bias,
+                             datatype=data_type,
+                             name=f"h.{i}.mlp.dense_h_to_4h")
+            act = model.gelu(up)
+            down = model.dense(act, c.hidden_size, use_bias=c.bias,
+                               datatype=data_type,
+                               name=f"h.{i}.mlp.dense_4h_to_h")
+            h = model.add(model.add(h, attn), down)
+
+    h = ln(h, "ln_f")
+    logits = model.dense(h, c.vocab_size, use_bias=False, datatype=data_type,
+                         name="lm_head")
+    gen = generation_config or GenerationConfig()
+    if gen.do_sample and mode == InferenceMode.INC_DECODING_MODE:
+        out = model.sampling(logits, top_p=gen.topp, temperature=gen.temperature)
+    else:
+        out = model.argmax(logits)
+    return out
+
+
+def preprocess_hf_state_dict(sd, config: FalconConfig):
+    """Split each fused query_key_value projection into q/k/v pseudo-keys.
+
+    Mirrors the TP-aware qkv split the reference does at weight-load time
+    (reference inference/file_loader.cc load_weights) but follows HF Falcon's
+    three fused layouts (multi-query / classic MHA / grouped new-arch).
+    """
+    c = config
+    hd = c.hidden_size // c.num_attention_heads
+    H, KH = c.num_attention_heads, c.num_kv_heads
+    for i in range(c.num_hidden_layers):
+        base = f"transformer.h.{i}.self_attention"
+        for suffix in ("weight",) + (("bias",) if c.bias else ()):
+            key = f"{base}.query_key_value.{suffix}"
+            if key not in sd:
+                continue
+            fused = _to_numpy(sd.pop(key))
+            cols = fused.shape[1:]  # () for bias, (hidden,) for weight
+            if c.new_decoder_architecture:
+                g = H // KH
+                f = fused.reshape((KH, g + 2, hd) + cols)
+                q = f[:, :-2].reshape((H * hd,) + cols)
+                k = f[:, -2].reshape((KH * hd,) + cols)
+                v = f[:, -1].reshape((KH * hd,) + cols)
+            elif KH == 1:
+                q = fused[: H * hd]
+                k = fused[H * hd: (H + 1) * hd]
+                v = fused[(H + 1) * hd:]
+            else:  # classic MHA: [n_head, 3, head_dim, ...] interleaved
+                f = fused.reshape((H, 3, hd) + cols)
+                q = f[:, 0].reshape((H * hd,) + cols)
+                k = f[:, 1].reshape((H * hd,) + cols)
+                v = f[:, 2].reshape((H * hd,) + cols)
+            sd[f"{base}.q_proj.{suffix}"] = q
+            sd[f"{base}.k_proj.{suffix}"] = k
+            sd[f"{base}.v_proj.{suffix}"] = v
+    tie_lm_head(sd, "transformer.word_embeddings.weight")
+
+
+def hf_weight_map(config: FalconConfig):
+    """HF state-dict key -> (layer_name, weight_name, transpose?).
+
+    Apply ``preprocess_hf_state_dict`` first (fused qkv split + tied head).
+    """
+    c = config
+    m = {"transformer.word_embeddings.weight": ("word_embeddings", "weight",
+                                                False),
+         "transformer.ln_f.weight": ("ln_f", "gamma", False),
+         "transformer.ln_f.bias": ("ln_f", "beta", False),
+         "lm_head.weight": ("lm_head", "kernel", True)}
+    for i in range(c.num_hidden_layers):
+        hf, ff = f"transformer.h.{i}", f"h.{i}"
+        for p, w in (("q_proj", "wq"), ("k_proj", "wk"), ("v_proj", "wv"),
+                     ("dense", "wo")):
+            m[f"{hf}.self_attention.{p}.weight"] = (
+                f"{ff}.self_attention", w, True)
+            if c.bias:
+                b = {"wq": "bq", "wk": "bk", "wv": "bv", "wo": "bo"}[w]
+                m[f"{hf}.self_attention.{p}.bias"] = (
+                    f"{ff}.self_attention", b, False)
+        for p in ("dense_h_to_4h", "dense_4h_to_h"):
+            m[f"{hf}.mlp.{p}.weight"] = (f"{ff}.mlp.{p}", "kernel", True)
+            if c.bias:
+                m[f"{hf}.mlp.{p}.bias"] = (f"{ff}.mlp.{p}", "bias", False)
+        if c.new_decoder_architecture:
+            lns = ("ln_attn", "ln_mlp")
+        elif c.parallel_attn:
+            lns = ("input_layernorm",)
+        else:
+            lns = ("input_layernorm", "post_attention_layernorm")
+        for lnname in lns:
+            m[f"{hf}.{lnname}.weight"] = (f"{ff}.{lnname}", "gamma", False)
+            m[f"{hf}.{lnname}.bias"] = (f"{ff}.{lnname}", "beta", False)
+    return m

@@ -25,15 +25,27 @@ def _to_numpy(t) -> np.ndarray:
         return np.asarray(t)
 
 
+def tie_lm_head(state_dict: Dict[str, Any], wte_key: str,
+                lm_head_key: str = "lm_head.weight") -> None:
+    """Materialize a tied lm_head from the word-embedding table."""
+    if lm_head_key not in state_dict and wte_key in state_dict:
+        state_dict[lm_head_key] = state_dict[wte_key]
+
+
 def load_hf_state_dict(model, state_dict: Mapping[str, Any],
                        weight_map: Dict[str, tuple],
-                       strict: bool = True) -> int:
+                       strict: bool = True, preprocess=None) -> int:
     """Copy HF weights into a compiled FFModel's params.
 
     weight_map: hf_key -> (layer_name, weight_name, transpose). Returns the
     number of tensors loaded. Params keep their existing dtype + sharding
     (set_parameter_by_key device_puts with the param's NamedSharding).
+    preprocess(dict) mutates a shallow copy first (fused-qkv splits, tied
+    embeddings) so the map stays a mechanical rename.
     """
+    if preprocess is not None:
+        state_dict = dict(state_dict)
+        preprocess(state_dict)
     loaded = 0
     missing = []
     for hf_key, (layer, wname, transpose) in weight_map.items():

@@ -101,6 +101,12 @@ def create_llama_model(model, config: LLAMAConfig,
     return out
 
 
+def preprocess_hf_state_dict(sd, config: "LLAMAConfig" = None):
+    from flexflow_tpu.models.hf_utils import tie_lm_head
+
+    tie_lm_head(sd, "model.embed_tokens.weight")
+
+
 def hf_weight_map(config: LLAMAConfig):
     """HF state-dict key -> (layer_name, weight_name, transpose?)."""
     m = {"model.embed_tokens.weight": ("embed_tokens", "weight", False),

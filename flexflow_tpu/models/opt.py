@@ -1,0 +1,173 @@
+"""OPT decoder for serving.
+
+Capability parity with the reference OPT builder (reference
+inference/models/opt.cc:23 create_opt_model and
+python/flexflow/serve/models/opt.py): token + learned positional embeddings
+(position offset 2, reference ff.set_position_offset(2)), pre- or post-
+layernorm blocks, attention with qkv/out biases and query scaling
+(scaling_query=true, factor head_dim^-0.5, qk_prod_scaling=false — the
+reference's flag set mirroring HF OPT's query-side scaling), ReLU FFN.
+Layer names follow the HF checkpoint layout for mechanical weight renames.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from flexflow_tpu.ffconst import ActiMode, DataType, InferenceMode
+from flexflow_tpu.models.hf_utils import tie_lm_head
+from flexflow_tpu.serve.batch_config import GenerationConfig
+
+
+@dataclasses.dataclass
+class OPTConfig:
+    vocab_size: int = 50272
+    hidden_size: int = 768
+    ffn_dim: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 2048
+    word_embed_proj_dim: int = 768
+    do_layer_norm_before: bool = True
+    layer_norm_elementwise_affine: bool = True
+    enable_bias: bool = True
+
+    @classmethod
+    def from_hf_config(cls, hf) -> "OPTConfig":
+        get = (lambda k, d=None: getattr(hf, k, d)) if not isinstance(hf, dict) \
+            else (lambda k, d=None: hf.get(k, d))
+        return cls(
+            vocab_size=get("vocab_size", 50272),
+            hidden_size=get("hidden_size", 768),
+            ffn_dim=get("ffn_dim", 3072),
+            num_hidden_layers=get("num_hidden_layers", 12),
+            num_attention_heads=get("num_attention_heads", 12),
+            max_position_embeddings=get("max_position_embeddings", 2048),
+            word_embed_proj_dim=get("word_embed_proj_dim")
+            or get("hidden_size", 768),
+            do_layer_norm_before=get("do_layer_norm_before", True),
+            layer_norm_elementwise_affine=get(
+                "layer_norm_elementwise_affine", True),
+            enable_bias=get("enable_bias", True),
+        )
+
+
+def create_opt_model(model, config: OPTConfig,
+                     mode: InferenceMode = InferenceMode.INC_DECODING_MODE,
+                     generation_config: Optional[GenerationConfig] = None,
+                     data_type: DataType = DataType.DT_FLOAT):
+    """Record the OPT decoder graph into ``model`` (an FFModel)."""
+    c = config
+    R = model.config.max_requests_per_batch
+    head_dim = c.hidden_size // c.num_attention_heads
+    tokens = model.create_tensor([R, 1], DataType.DT_INT32)
+    positions = model.create_position_tensor([R, 1])
+    model.set_position_offset(2)  # reference opt.cc ff.set_position_offset(2)
+
+    tok = model.embedding(tokens, c.vocab_size, c.word_embed_proj_dim,
+                          dtype=data_type, name="embed_tokens")
+    if c.word_embed_proj_dim != c.hidden_size:
+        tok = model.dense(tok, c.hidden_size, use_bias=False,
+                          datatype=data_type, name="project_in")
+    pos = model.embedding(positions, c.max_position_embeddings + 2,
+                          c.hidden_size, dtype=data_type,
+                          name="embed_positions")
+    h = model.add(tok, pos)
+
+    if mode == InferenceMode.TREE_VERIFY_MODE:
+        attn_builder = model.tree_inc_multihead_self_attention
+    elif mode == InferenceMode.BEAM_SEARCH_MODE:
+        attn_builder = model.spec_inc_multihead_self_attention
+    else:
+        attn_builder = model.inc_multihead_self_attention
+
+    for i in range(c.num_hidden_layers):
+        residual = h
+        if c.do_layer_norm_before:
+            x = model.layer_norm(
+                h, axes=[-1], use_bias=True,
+                elementwise_affine=c.layer_norm_elementwise_affine,
+                name=f"layers.{i}.self_attn_layer_norm")
+        else:
+            x = h
+        attn = attn_builder(
+            x, c.hidden_size, c.num_attention_heads, data_type=data_type,
+            bias=c.enable_bias, apply_rotary_embedding=False,
+            scaling_query=True, scaling_factor=head_dim ** -0.5,
+            qk_prod_scaling=False, name=f"layers.{i}.self_attn")
+        h = model.add(residual, attn)
+        if not c.do_layer_norm_before:
+            h = model.layer_norm(
+                h, axes=[-1], use_bias=True,
+                elementwise_affine=c.layer_norm_elementwise_affine,
+                name=f"layers.{i}.self_attn_layer_norm")
+        residual = h
+        if c.do_layer_norm_before:
+            x = model.layer_norm(
+                h, axes=[-1], use_bias=True,
+                elementwise_affine=c.layer_norm_elementwise_affine,
+                name=f"layers.{i}.final_layer_norm")
+        else:
+            x = h
+        fc1 = model.dense(x, c.ffn_dim, ActiMode.AC_MODE_RELU,
+                          use_bias=c.enable_bias, datatype=data_type,
+                          name=f"layers.{i}.fc1")
+        fc2 = model.dense(fc1, c.hidden_size, use_bias=c.enable_bias,
+                          datatype=data_type, name=f"layers.{i}.fc2")
+        h = model.add(residual, fc2)
+        if not c.do_layer_norm_before:
+            h = model.layer_norm(
+                h, axes=[-1], use_bias=True,
+                elementwise_affine=c.layer_norm_elementwise_affine,
+                name=f"layers.{i}.final_layer_norm")
+
+    if c.do_layer_norm_before:
+        h = model.layer_norm(h, axes=[-1], use_bias=True,
+                             elementwise_affine=c.layer_norm_elementwise_affine,
+                             name="final_layer_norm")
+    if c.word_embed_proj_dim != c.hidden_size:
+        h = model.dense(h, c.word_embed_proj_dim, use_bias=False,
+                        datatype=data_type, name="project_out")
+    logits = model.dense(h, c.vocab_size, use_bias=False, datatype=data_type,
+                         name="lm_head")
+    gen = generation_config or GenerationConfig()
+    if gen.do_sample and mode == InferenceMode.INC_DECODING_MODE:
+        out = model.sampling(logits, top_p=gen.topp, temperature=gen.temperature)
+    else:
+        out = model.argmax(logits)
+    return out
+
+
+def preprocess_hf_state_dict(sd, config: Optional[OPTConfig] = None):
+    tie_lm_head(sd, "model.decoder.embed_tokens.weight")
+
+
+def hf_weight_map(config: OPTConfig):
+    """HF state-dict key -> (layer_name, weight_name, transpose?)."""
+    pre = "model.decoder"
+    m = {f"{pre}.embed_tokens.weight": ("embed_tokens", "weight", False),
+         f"{pre}.embed_positions.weight": ("embed_positions", "weight", False),
+         "lm_head.weight": ("lm_head", "kernel", True)}
+    if config.do_layer_norm_before:
+        m[f"{pre}.final_layer_norm.weight"] = ("final_layer_norm", "gamma", False)
+        m[f"{pre}.final_layer_norm.bias"] = ("final_layer_norm", "beta", False)
+    if config.word_embed_proj_dim != config.hidden_size:
+        m[f"{pre}.project_in.weight"] = ("project_in", "kernel", True)
+        m[f"{pre}.project_out.weight"] = ("project_out", "kernel", True)
+    for i in range(config.num_hidden_layers):
+        hf, ff = f"{pre}.layers.{i}", f"layers.{i}"
+        for p, w in (("q_proj", "wq"), ("k_proj", "wk"), ("v_proj", "wv"),
+                     ("out_proj", "wo")):
+            m[f"{hf}.self_attn.{p}.weight"] = (f"{ff}.self_attn", w, True)
+            if config.enable_bias:
+                b = {"wq": "bq", "wk": "bk", "wv": "bv", "wo": "bo"}[w]
+                m[f"{hf}.self_attn.{p}.bias"] = (f"{ff}.self_attn", b, False)
+        for p in ("fc1", "fc2"):
+            m[f"{hf}.{p}.weight"] = (f"{ff}.{p}", "kernel", True)
+            if config.enable_bias:
+                m[f"{hf}.{p}.bias"] = (f"{ff}.{p}", "bias", False)
+        for ln in ("self_attn_layer_norm", "final_layer_norm"):
+            m[f"{hf}.{ln}.weight"] = (f"{ff}.{ln}", "gamma", False)
+            m[f"{hf}.{ln}.bias"] = (f"{ff}.{ln}", "beta", False)
+    return m

@@ -33,7 +33,9 @@ _UNARY_FNS = {
     OpType.SIGMOID: jax.nn.sigmoid,
     OpType.TANH: jnp.tanh,
     OpType.ELU: jax.nn.elu,
-    OpType.GELU: jax.nn.gelu,
+    # Exact (erf) form — matches torch.nn.GELU() which the HF alignment
+    # oracle uses; the tanh approximation is selected via attrs["approximate"].
+    OpType.GELU: lambda x: jax.nn.gelu(x, approximate=False),
     OpType.EXP: jnp.exp,
     OpType.SIN: jnp.sin,
     OpType.COS: jnp.cos,
@@ -67,6 +69,8 @@ class ElementUnary(OpImpl):
 
     @staticmethod
     def forward(attrs, params, inputs, ctx):
+        if attrs["op_type"] == OpType.GELU and attrs.get("approximate", False):
+            return [jax.nn.gelu(inputs[0], approximate=True)]
         fn = _UNARY_FNS[attrs["op_type"]]
         return [fn(inputs[0])]
 

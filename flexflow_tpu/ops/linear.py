@@ -34,7 +34,7 @@ def apply_activation(x, mode: ActiMode):
     if mode == ActiMode.AC_MODE_TANH:
         return jnp.tanh(x)
     if mode == ActiMode.AC_MODE_GELU:
-        return jax.nn.gelu(x)
+        return jax.nn.gelu(x, approximate=False)  # torch.nn.GELU parity
     raise ValueError(mode)
 
 

@@ -26,8 +26,12 @@ from flexflow_tpu.serve.request_manager import (
     get_request_manager,
 )
 from flexflow_tpu.serve.inference_manager import InferenceManager
+from flexflow_tpu.serve.api import LLM, SSM, init
 
 __all__ = [
+    "LLM",
+    "SSM",
+    "init",
     "BatchMeta",
     "TreeBatchMeta",
     "GenerationConfig",

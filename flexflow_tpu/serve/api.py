@@ -1,0 +1,226 @@
+"""User-facing serving API: ``LLM``, ``SSM``, ``init``.
+
+Capability parity with the reference Python serve API (reference
+python/flexflow/serve/serve.py: LLM :71 with .compile :305 / .generate :407,
+SSM :429, and serve/__init__.py init() :94): an LLM wraps a HuggingFace
+checkpoint, compiles it into a serving FFModel (incremental decoding, or
+tree-verify when draft SSMs are attached), and generates through the
+RequestManager's continuous-batching loops.
+
+TPU-first: no weight-file export/reload round trip (the reference converts
+HF checkpoints to a binary per-layer layout, serve.py:167-303, then
+file_loader.cc re-reads them) — the HF state dict maps straight into the
+sharded param pytree, and TP/PP degrees become mesh axes instead of
+MachineView assignments.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional, Sequence, Union
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.ffconst import CompMode, DataType, InferenceMode
+from flexflow_tpu.serve.batch_config import GenerationConfig
+from flexflow_tpu.serve.request_manager import (GenerationResult,
+                                                RequestManager)
+
+_global_init_kwargs: dict = {}
+
+
+def init(configs_dict: Optional[dict] = None, **kwargs):
+    """Configure serving defaults (reference serve/__init__.py init() :94).
+
+    The reference synthesizes Legion argv (num_gpus, memory_per_gpu,
+    zero_copy_memory_per_node, ...). On TPU there is no resource argv to
+    build — accepted keys that map to FFConfig fields are stored and applied
+    to every subsequently-created LLM; Legion-only keys are ignored.
+    """
+    global _global_init_kwargs
+    merged = dict(configs_dict or {})
+    merged.update(kwargs)
+    known = {f.name for f in FFConfig.__dataclass_fields__.values()}
+    aliases = {
+        "num_gpus": "num_devices",
+        "num_cpus": None,
+        "memory_per_gpu": None,
+        "zero_copy_memory_per_node": None,
+        "legion_utility_processors": None,
+        "use_4bit_quantization": ("quantization_type", "int4"),
+        "use_8bit_quantization": ("quantization_type", "int8"),
+        "offload": ("cpu_offload", True),
+        "fusion": "enable_fusion",
+    }
+    out = {}
+    for k, v in merged.items():
+        if k in known:
+            out[k] = v
+        elif k in aliases:
+            a = aliases[k]
+            if a is None:
+                continue  # Legion resource knob with no TPU meaning
+            if isinstance(a, tuple):
+                if v:
+                    out[a[0]] = a[1]
+            else:
+                out[a] = v
+        # unknown keys ignored (parse_known_args parity)
+    _global_init_kwargs = out
+    return out
+
+
+def _is_hf_model(obj) -> bool:
+    return hasattr(obj, "state_dict") and hasattr(obj, "config")
+
+
+class LLM:
+    """A large language model to serve (reference serve/serve.py:71).
+
+    ``model`` may be:
+      * a transformers ``PreTrainedModel`` (weights already in memory),
+      * a local HF checkpoint directory (loaded via transformers),
+      * a ``(hf_config, state_dict)`` pair.
+    """
+
+    inference_mode = InferenceMode.INC_DECODING_MODE
+
+    def __init__(self, model: Any,
+                 data_type: DataType = DataType.DT_FLOAT,
+                 tokenizer: Any = None,
+                 cache_path: str = "",
+                 refresh_cache: bool = False,
+                 output_file: str = ""):
+        from flexflow_tpu.models import family_for_hf_config
+
+        self.data_type = data_type
+        self.output_file = output_file
+        self.tokenizer = tokenizer
+        self.ffmodel = None
+        self.ssms: List["SSM"] = []
+        self.rm: Optional[RequestManager] = None
+
+        if isinstance(model, (tuple, list)) and len(model) == 2:
+            self.hf_config, self._state_dict = model
+        elif _is_hf_model(model):
+            self.hf_config = model.config
+            self._state_dict = model.state_dict()
+        elif isinstance(model, str):
+            import transformers
+
+            local = os.path.isdir(model)
+            hf = transformers.AutoModelForCausalLM.from_pretrained(
+                model, local_files_only=local)
+            self.hf_config = hf.config
+            self._state_dict = hf.state_dict()
+            if self.tokenizer is None:
+                try:
+                    self.tokenizer = transformers.AutoTokenizer.from_pretrained(
+                        model, local_files_only=local)
+                except Exception:
+                    self.tokenizer = None
+        else:
+            raise TypeError(f"unsupported model source: {type(model)}")
+        self.family = family_for_hf_config(self.hf_config)
+        self.model_config = self.family.config_cls.from_hf_config(
+            self.hf_config)
+
+    # ------------------------------------------------------------------
+    def compile(self,
+                generation_config: Optional[GenerationConfig] = None,
+                max_requests_per_batch: int = 1,
+                max_seq_length: int = 256,
+                max_tokens_per_batch: int = 64,
+                model_specific_data_parallelism_degree: int = 1,
+                model_specific_tensor_parallelism_degree: int = 1,
+                model_specific_pipeline_parallelism_degree: int = 1,
+                ssms: Sequence["SSM"] = (),
+                **ffconfig_kwargs):
+        """Build + jit the serving graph (reference LLM.compile :305)."""
+        self.generation_config = generation_config or GenerationConfig()
+        self.ssms = list(ssms)
+        mode = (InferenceMode.TREE_VERIFY_MODE if self.ssms
+                else self.inference_mode)
+
+        kw = dict(_global_init_kwargs)
+        kw.update(ffconfig_kwargs)
+        kw.setdefault("data_parallelism_degree",
+                      model_specific_data_parallelism_degree)
+        kw.setdefault("tensor_parallelism_degree",
+                      model_specific_tensor_parallelism_degree)
+        kw.setdefault("pipeline_parallelism_degree",
+                      model_specific_pipeline_parallelism_degree)
+        config = FFConfig(max_requests_per_batch=max_requests_per_batch,
+                          max_sequence_length=max_seq_length,
+                          max_tokens_per_batch=max_tokens_per_batch, **kw)
+
+        from flexflow_tpu.core.model import FFModel
+
+        self.ffmodel = FFModel(config)
+        self.family.build(self.ffmodel, self.model_config, mode=mode,
+                          generation_config=self.generation_config,
+                          data_type=self.data_type)
+        self.ffmodel.compile(comp_mode=CompMode.COMP_MODE_INFERENCE)
+        self.family.load_hf(self.ffmodel, self.model_config,
+                            self._state_dict)
+        # weights now live on device with their shardings; drop the host
+        # copy so a 7B checkpoint doesn't stay resident twice
+        self._state_dict = None
+
+        self.rm = RequestManager()
+        if self.tokenizer is not None:
+            self.rm.register_tokenizer(self.tokenizer)
+        else:
+            eos = getattr(self.hf_config, "eos_token_id", None)
+            self.rm.eos_token_id = eos
+        if self.output_file:
+            self.rm.register_output_filepath(self.output_file)
+
+        # Draft models must share the verifier's batch geometry so request
+        # slots line up across caches (reference RequestManager assumes one
+        # BatchConfig shape across llm+ssms).
+        for ssm in self.ssms:
+            ssm.compile(generation_config=self.generation_config,
+                        max_requests_per_batch=max_requests_per_batch,
+                        max_seq_length=max_seq_length,
+                        max_tokens_per_batch=max_tokens_per_batch,
+                        **ffconfig_kwargs)
+        return self
+
+    # ------------------------------------------------------------------
+    def generate(self, requests_or_prompts: Union[str, Sequence],
+                 max_new_tokens: int = 128,
+                 max_length: int = 0
+                 ) -> Union[GenerationResult, List[GenerationResult]]:
+        """Generate (reference LLM.generate :407): continuous batching over
+        prompts; speculative tree decoding when SSMs are attached."""
+        if self.ffmodel is None:
+            raise RuntimeError("call LLM.compile() before generate()")
+        single = isinstance(requests_or_prompts, str) or (
+            requests_or_prompts and
+            isinstance(requests_or_prompts[0], int))
+        prompts = [requests_or_prompts] if single else list(requests_or_prompts)
+        guids = [self.rm.register_new_request(
+            p, max_new_tokens=max_new_tokens, max_sequence_length=max_length)
+            for p in prompts]
+        if self.ssms:
+            self.rm.generate_spec_infer(
+                self.ffmodel, [s.ffmodel for s in self.ssms])
+        else:
+            self.rm.generate_incr_decoding(self.ffmodel)
+        # prompt order, not completion order (results[i] pairs with prompts[i])
+        results = [self.rm.results[g] for g in guids]
+        return results[0] if single else results
+
+    # parity no-ops: the reference starts a background RequestManager server
+    # (serve.py start_server); our generate loops run inline.
+    def start_server(self):
+        return self
+
+    def stop_server(self):
+        return self
+
+
+class SSM(LLM):
+    """Small speculative model / draft model (reference serve/serve.py:429)."""
+
+    inference_mode = InferenceMode.BEAM_SEARCH_MODE

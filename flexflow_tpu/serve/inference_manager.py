@@ -42,7 +42,8 @@ class InferenceManager:
         feeds = {model.input_tensors[0].tensor_id: meta.tokens}
         pos_t = getattr(model, "position_input_tensor", None)
         if pos_t is not None:
-            feeds[pos_t.tensor_id] = meta.positions
+            feeds[pos_t.tensor_id] = (
+                meta.positions + getattr(model, "position_offset", 0))
         values, new_state = model._run_graph(params, feeds, ctx, op_state)
         out_tokens = values[model._final_tensor.tensor_id]
         return out_tokens, new_state

@@ -93,6 +93,13 @@ class RequestManager:
         self.results: Dict[int, GenerationResult] = {}
         self.max_spec_depth = MAX_BEAM_DEPTH
         self._commit = jax.jit(commit_tree_kv, donate_argnums=(0,))
+        self.output_filepath: Optional[str] = None
+
+    def register_output_filepath(self, path: str):
+        """Per-request output log (reference register_output_filepath :155:
+        serving writes each request's text + latency to -output-file)."""
+        self.output_filepath = path
+        open(path, "w").close()  # truncate like the reference
 
     # -- registration (reference register_new_request, tokenization) -------
     def register_tokenizer(self, tokenizer, eos_token_id=None):
@@ -140,6 +147,11 @@ class RequestManager:
             except Exception:
                 pass
         self.results[req.guid] = res
+        if self.output_filepath:
+            with open(self.output_filepath, "a") as f:
+                f.write(f"guid({res.guid})\n"
+                        f"input: {res.input_text or res.input_tokens}\n"
+                        f"output: {res.output_text or res.output_tokens}\n")
         return res
 
     def _fill_slots(self, active: List[Optional[Request]], max_seq: int,
