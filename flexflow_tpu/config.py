@@ -64,6 +64,13 @@ class FFConfig:
     max_tokens_per_batch: int = 128
     max_sequence_length: int = 256
     kv_cache_dtype: str = "bfloat16"
+    # fused serving-loop block sizes (serve/engine.py): how many decode
+    # steps / speculation rounds run on device per host round-trip. The
+    # TPU equivalent of the reference's depth-4 in-flight batch pipeline
+    # (request_manager.cc:1829) — larger blocks amortize dispatch latency
+    # at the cost of more overshoot past EOS.
+    decode_block_steps: int = 8
+    spec_rounds_per_call: int = 4
 
     # --- serving / offload / quantization (reference config.h:144-163) ---
     cpu_offload: bool = False

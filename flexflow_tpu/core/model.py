@@ -623,6 +623,14 @@ class FFModel:
                 input_specs = [(t.dims, t.dtype) for t in layer.inputs]
                 self.op_state[layer.name] = impl.init_state(layer.attrs,
                                                             input_specs)
+        self._consolidate_kv_caches()
+        # Commit op-state (KV caches) to the mesh NOW: jit caches key on
+        # argument shardings, so uncommitted zeros here would make the first
+        # post-warmup call recompile every serving program once the donated
+        # outputs come back with concrete placements.
+        self.op_state = jax.tree.map(
+            lambda x: jax.device_put(x, self.policy.replicated()),
+            self.op_state)
 
         # --- label tensor (reference compile creates it from final output) ---
         final = self.layers[-1].outputs[0] if self.layers else None
@@ -692,6 +700,31 @@ class FFModel:
         self._eval_step = jax.jit(eval_step)
         self._predict_step = jax.jit(predict_step)
         self._compiled = True
+
+    def _consolidate_kv_caches(self):
+        """Stack homogeneous per-layer KV caches into two [L, ...] arrays.
+
+        Cuts the per-call donated-buffer count from 2*num_layers to 2 (each
+        device buffer costs a host round-trip under remote runtimes) and
+        lets the speculative tree commit vectorize over layers. Layers get
+        attrs["cache_layer_idx"]; see ops/inc_attention.py read_kv/write_kv.
+        """
+        names = [n for n, st in self.op_state.items()
+                 if isinstance(st, dict) and "k_cache" in st]
+        if len(names) < 2:
+            return
+        shapes = {self.op_state[n]["k_cache"].shape for n in names}
+        dtypes = {self.op_state[n]["k_cache"].dtype for n in names}
+        if len(shapes) != 1 or len(dtypes) != 1:
+            return  # heterogeneous caches keep the per-layer layout
+        by_name = {layer.name: layer for layer in self.layers}
+        for i, n in enumerate(names):
+            by_name[n].attrs["cache_layer_idx"] = i
+        k = jnp.stack([self.op_state[n]["k_cache"] for n in names])
+        v = jnp.stack([self.op_state[n]["v_cache"] for n in names])
+        for n in names:
+            del self.op_state[n]
+        self.op_state["kv_cache"] = {"k": k, "v": v}
 
     # ==================================================================
     # Training verbs (reference model.cc:2784/2807/2838 + fit)

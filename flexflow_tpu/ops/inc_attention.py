@@ -178,6 +178,36 @@ def _project_out(attrs, params, ctx, attn_out):
     return out
 
 
+# ----------------------------------------------------------------------
+# KV-cache state access. Two layouts:
+#  * per-layer (default): op_state[layer_name] = {"k_cache", "v_cache"}
+#  * stacked (consolidated by FFModel.compile when all serving-attention
+#    layers share one cache shape): op_state["kv_cache"] = {"k": [L, ...],
+#    "v": [L, ...]} and each layer carries attrs["cache_layer_idx"].
+# Stacking cuts the donated-arg count from 2*L to 2 — under a remote/tunnel
+# runtime every buffer costs a round trip per call, and it lets tree-commit
+# run vectorized over layers.
+# ----------------------------------------------------------------------
+def read_kv(ctx, attrs):
+    idx = attrs.get("cache_layer_idx")
+    if idx is None:
+        st = ctx.state_in[ctx.layer_name]
+        return st["k_cache"], st["v_cache"]
+    st = ctx.state_out.get("kv_cache") or ctx.state_in["kv_cache"]
+    return st["k"][idx], st["v"][idx]
+
+
+def write_kv(ctx, attrs, k_cache, v_cache):
+    idx = attrs.get("cache_layer_idx")
+    if idx is None:
+        ctx.state_out[ctx.layer_name] = {"k_cache": k_cache,
+                                         "v_cache": v_cache}
+        return
+    st = ctx.state_out.get("kv_cache") or ctx.state_in["kv_cache"]
+    ctx.state_out["kv_cache"] = {"k": st["k"].at[idx].set(k_cache),
+                                 "v": st["v"].at[idx].set(v_cache)}
+
+
 @register_op_as(OpType.INC_MULTIHEAD_SELF_ATTENTION,
                 OpType.SPEC_INC_MULTIHEAD_SELF_ATTENTION)
 class IncMultiHeadSelfAttention(OpImpl):
@@ -204,18 +234,18 @@ class IncMultiHeadSelfAttention(OpImpl):
         x = inputs[0]
         meta = ctx.batch_config
         assert meta is not None, "serving ops need ctx.batch_config"
-        state = ctx.state_in[ctx.layer_name]
+        k_cache0, v_cache0 = read_kv(ctx, attrs)
         q, k, v = _qkv(attrs, params, x, ctx.compute_dtype)
         if attrs.get("apply_rotary_embedding", False):
             cos, sin = rotary_cos_sin(meta.positions, attrs["head_dim"],
                                       attrs.get("rope_theta", 10000.0), q.dtype)
             q = apply_rotary(q, cos, sin)
             k = apply_rotary(k, cos, sin)
-        k_cache = append_kv(state["k_cache"], k, meta.start_pos,
+        k_cache = append_kv(k_cache0, k, meta.start_pos,
                             meta.num_tokens, meta.active)
-        v_cache = append_kv(state["v_cache"], v, meta.start_pos,
+        v_cache = append_kv(v_cache0, v, meta.start_pos,
                             meta.num_tokens, meta.active)
-        ctx.state_out[ctx.layer_name] = {"k_cache": k_cache, "v_cache": v_cache}
+        write_kv(ctx, attrs, k_cache, v_cache)
         # Causal mask over absolute cache positions: query token i (at
         # position start+i) sees cache[s] for s <= start+i.
         S = k_cache.shape[1]
@@ -258,7 +288,7 @@ class TreeIncMultiHeadSelfAttention(OpImpl):
             # Prompt prefill reaches the verify model as a plain causal
             # batch (a chain is a degenerate tree) — same as incremental.
             return IncMultiHeadSelfAttention.forward(attrs, params, inputs, ctx)
-        state = ctx.state_in[ctx.layer_name]
+        k_cache0, v_cache0 = read_kv(ctx, attrs)
         q, k, v = _qkv(attrs, params, x, ctx.compute_dtype)
         if attrs.get("apply_rotary_embedding", False):
             cos, sin = rotary_cos_sin(meta.positions, attrs["head_dim"],
@@ -267,11 +297,11 @@ class TreeIncMultiHeadSelfAttention(OpImpl):
             k = apply_rotary(k, cos, sin)
         # Stage tree KV at cache[start + node_idx] (node order is the
         # flattened tree, so this is the same scatter as incremental append).
-        k_cache = append_kv(state["k_cache"], k, meta.start_pos,
+        k_cache = append_kv(k_cache0, k, meta.start_pos,
                             meta.num_nodes, meta.active)
-        v_cache = append_kv(state["v_cache"], v, meta.start_pos,
+        v_cache = append_kv(v_cache0, v, meta.start_pos,
                             meta.num_nodes, meta.active)
-        ctx.state_out[ctx.layer_name] = {"k_cache": k_cache, "v_cache": v_cache}
+        write_kv(ctx, attrs, k_cache, v_cache)
         # Mask: committed prefix OR ancestor-or-self within the tree region.
         S = k_cache.shape[1]
         T = x.shape[1]
@@ -318,7 +348,12 @@ def commit_tree_kv(op_state: Dict[str, Any], src_node: jnp.ndarray,
 
     new_state = {}
     for layer_name, st in op_state.items():
-        if isinstance(st, dict) and "k_cache" in st:
+        if layer_name == "kv_cache":  # stacked [L, R, S, KH, D] layout
+            new_state[layer_name] = {
+                "k": jax.vmap(commit_one)(st["k"]),
+                "v": jax.vmap(commit_one)(st["v"]),
+            }
+        elif isinstance(st, dict) and "k_cache" in st:
             new_state[layer_name] = {
                 "k_cache": commit_one(st["k_cache"]),
                 "v_cache": commit_one(st["v_cache"]),

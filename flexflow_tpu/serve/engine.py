@@ -1,0 +1,187 @@
+"""Fused on-device serving loops: multi-step decode and chain speculation.
+
+The reference hides per-step latency by pipelining Legion futures (reference
+request_manager.cc:1829-1845 keeps a depth-4 batch queue in flight, with
+Legion traces replaying the task DAG). The TPU-native equivalent is to move
+the loop itself onto the device: a `lax.while_loop` over decode steps (or
+whole speculation rounds) runs inside ONE jitted program, so host<->device
+round-trips happen once per block instead of once per token. The trip count
+is a DYNAMIC device scalar bounded by a static maximum — one compiled
+program serves every block size, and the device only executes the steps
+asked for. The host scheduler reconciles EOS/length truncation after
+reading each block — overshoot work is bounded and the KV caches self-heal
+because positions are recomputed from host state at every call.
+
+Two engines:
+* ``decode_block`` (on InferenceManager): n greedy/sampled decode steps per
+  call for incremental decoding.
+* ``SpecChainEngine``: the MAX_BEAM_WIDTH=1 speculation path (the reference
+  default, batch_config.h:125) fully fused — draft-chain scan + tree(chain)
+  verification + acceptance + implicit KV commit per round. A chain needs
+  no KV compaction at all: accepted nodes are already contiguous in both
+  caches (the reference needs commit_tokens_kernel only for branchy trees;
+  that path remains in request_manager for multi-SSM).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.ops.base import OpContext
+from flexflow_tpu.serve.batch_config import BatchMeta
+
+
+def _forward_tokens(model, params, state, tokens, positions, start_pos,
+                    num_tokens, active, rng, compute_dtype):
+    """One forward over [R, Q] tokens inside jit; returns (out, new_state)."""
+    meta = BatchMeta(tokens=tokens, positions=positions, start_pos=start_pos,
+                     num_tokens=num_tokens, active=active)
+    ctx = OpContext(training=False, rng=rng, compute_dtype=compute_dtype,
+                    batch_config=meta, mesh=model.mesh, config=model.config)
+    feeds = {model.input_tensors[0].tensor_id: tokens}
+    pos_t = getattr(model, "position_input_tensor", None)
+    if pos_t is not None:
+        feeds[pos_t.tensor_id] = positions + model.position_offset
+    values, new_state = model._run_graph(params, feeds, ctx, state)
+    return values[model._final_tensor.tensor_id], new_state
+
+
+def make_decode_block(model, compute_dtype, max_steps: int):
+    """Build the jitted dynamic-length decode program for ``model``.
+
+    Signature: (params, op_state, tok [R], pos [R], active [R], rng,
+    n (device scalar <= max_steps)) -> (tokens [R, max_steps], new_op_state,
+    last_tok [R]). Only the first n columns are meaningful; the rest stay 0.
+    ``pos[r]`` is the sequence index of the pending token ``tok[r]``.
+    One program compiles for ALL n (dynamic while_loop trip count).
+    """
+
+    def block(params, op_state, tok, pos, active, rng, n):
+        R = tok.shape[0]
+        num = active.astype(jnp.int32)
+        out0 = jnp.zeros((R, max_steps), jnp.int32)
+
+        def cond(carry):
+            i = carry[0]
+            return i < n
+
+        def body(carry):
+            i, state, tok, pos, out = carry
+            o, state = _forward_tokens(
+                model, params, state, tok[:, None], pos[:, None], pos, num,
+                active, jax.random.fold_in(rng, i), compute_dtype)
+            nxt = o[:, 0].astype(jnp.int32)
+            out = jax.lax.dynamic_update_slice(out, nxt[:, None], (0, i))
+            return i + 1, state, nxt, pos + 1, out
+
+        _, op_state, tok, _, out = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), op_state, tok, pos, out0))
+        return out, op_state, tok
+
+    return jax.jit(block, donate_argnums=(1,))
+
+
+class SpecChainEngine:
+    """Fused chain speculation: one device call per block of rounds.
+
+    Per round (all on device): the draft model decodes a greedy chain of
+    ``depth`` tokens (scan of depth+1 steps — the extra step back-fills the
+    draft KV for the accept-all case); the verifier scores the chain in one
+    width-(depth+1) causal pass; acceptance is the longest matching prefix
+    plus the verifier's bonus token. The number of rounds per call is a
+    dynamic scalar bounded by ``max_rounds`` — one compiled program total.
+    """
+
+    def __init__(self, llm, ssm, depth: int = 4, max_rounds: int = 16):
+        self.llm = llm
+        self.ssm = ssm
+        self.depth = depth
+        self.max_rounds = max_rounds
+        self._compute_dtype = jnp.dtype(llm.config.compute_dtype)
+        self._block = jax.jit(self._block_impl, donate_argnums=(1, 3))
+        # concrete (created outside any trace: jit closes over it as a const)
+        self._rng_const = jax.random.PRNGKey(llm.config.seed)
+
+    def _round(self, llm_params, llm_state, ssm_params, ssm_state, tok, pos,
+               rng, active):
+        d = self.depth
+        num = active.astype(jnp.int32)
+
+        # --- draft chain: depth+1 steps, last one only back-fills KV ---
+        def draft_body(carry, i):
+            state, t, p = carry
+            out, state = _forward_tokens(
+                self.ssm, ssm_params, state, t[:, None], p[:, None], p, num,
+                active, jax.random.fold_in(rng, i), self._compute_dtype)
+            nxt = out[:, 0].astype(jnp.int32)
+            return (state, nxt, p + 1), nxt
+
+        (ssm_state, _, _), chain = jax.lax.scan(
+            draft_body, (ssm_state, tok, pos), jnp.arange(d + 1))
+        chain = jnp.transpose(chain)[:, :d]                     # [R, d]
+
+        # --- verify: one causal pass over [pending, chain...] ---
+        vtokens = jnp.concatenate([tok[:, None], chain], axis=1)  # [R, d+1]
+        vpos = pos[:, None] + jnp.arange(d + 1)[None, :]
+        out, llm_state = _forward_tokens(
+            self.llm, llm_params, llm_state, vtokens, vpos, pos,
+            num * (d + 1), active, jax.random.fold_in(rng, d + 1),
+            self._compute_dtype)
+        a = out.astype(jnp.int32)                               # [R, d+1]
+
+        # --- greedy acceptance: longest prefix where chain matches ---
+        match = (chain == a[:, :d]).astype(jnp.int32)
+        n_acc = jnp.cumprod(match, axis=1).sum(axis=1)          # [R] in [0,d]
+        bonus = jnp.take_along_axis(a, n_acc[:, None], axis=1)[:, 0]
+        new_tok = bonus.astype(jnp.int32)
+        new_pos = pos + n_acc + 1
+        return llm_state, ssm_state, new_tok, new_pos, a, n_acc
+
+    def _block_impl(self, llm_params, llm_state, ssm_params, ssm_state, tok,
+                    pos, active, n_rounds):
+        R = tok.shape[0]
+        d = self.depth
+        rng0 = jax.random.fold_in(self._rng_const, pos.sum())
+        # packed output: [R, max_rounds, d+2] = verifier tokens ++ n_acc —
+        # the host reads ONE buffer per block (each separate device->host
+        # read costs a full round trip under remote runtimes).
+        packed0 = jnp.zeros((R, self.max_rounds, d + 2), jnp.int32)
+
+        def cond(carry):
+            return carry[0] < n_rounds
+
+        def body(carry):
+            i, llm_state, ssm_state, tok, pos, packed = carry
+            llm_state, ssm_state, tok, pos, a, n_acc = self._round(
+                llm_params, llm_state, ssm_params, ssm_state, tok, pos,
+                jax.random.fold_in(rng0, i), active)
+            row = jnp.concatenate([a, n_acc[:, None]], axis=1)  # [R, d+2]
+            packed = jax.lax.dynamic_update_slice(
+                packed, row[:, None, :], (0, i, 0))
+            return i + 1, llm_state, ssm_state, tok, pos, packed
+
+        (_, llm_state, ssm_state, _, _, packed) = jax.lax.while_loop(
+            cond, body,
+            (jnp.int32(0), llm_state, ssm_state, tok, pos, packed0))
+        return llm_state, ssm_state, packed
+
+    def run_block(self, tok: np.ndarray, pos: np.ndarray, active: np.ndarray,
+                  n_rounds: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Run ``n_rounds`` (<= max_rounds) rounds; returns (a, n_acc).
+
+        a[r, k] is round k's verifier outputs [depth+1]; the committed
+        tokens for slot r in round k are ``a[r, k, :n_acc[r, k] + 1]``.
+        Rows k >= n_rounds are zero-filled. Updates both models' op_state.
+        """
+        n_rounds = min(int(n_rounds), self.max_rounds)
+        (self.llm.op_state, self.ssm.op_state, packed) = self._block(
+            self.llm.params, self.llm.op_state, self.ssm.params,
+            self.ssm.op_state, jnp.asarray(tok), jnp.asarray(pos),
+            jnp.asarray(active), jnp.int32(n_rounds))
+        packed = np.asarray(packed)
+        return packed[:, :, :-1], packed[:, :, -1]

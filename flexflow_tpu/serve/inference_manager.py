@@ -32,6 +32,7 @@ class InferenceManager:
         self._compute_dtype = jnp.dtype(cfg.compute_dtype)
         self._step = jax.jit(self._step_impl, donate_argnums=(1,))
         self._rng = jax.random.PRNGKey(cfg.seed)
+        self._decode_block = None
 
     def _step_impl(self, params, op_state, meta, rng):
         model = self.model
@@ -60,3 +61,28 @@ class InferenceManager:
                                     meta, step_rng)
         self.model.op_state = new_state
         return np.asarray(out)
+
+    def decode_block(self, tok: np.ndarray, pos: np.ndarray,
+                     active: np.ndarray, n_steps: int) -> np.ndarray:
+        """Run ``n_steps`` fused decode steps in ONE device program.
+
+        The TPU answer to the reference's depth-4 in-flight Legion batch
+        pipeline (request_manager.cc:1829): instead of pipelining host-built
+        batches, the whole token-feedback loop runs on device via a
+        dynamic-trip while_loop — one host round-trip AND one compiled
+        program for every block size. Returns int32 [R, n_steps].
+        """
+        from flexflow_tpu.serve.engine import make_decode_block
+
+        if self._decode_block is None:
+            self._decode_block = make_decode_block(
+                self.model, self._compute_dtype,
+                self.model.config.decode_block_steps)
+        n_steps = min(int(n_steps), self.model.config.decode_block_steps)
+        self._rng, step_rng = jax.random.split(self._rng)
+        toks, new_state, _last = self._decode_block(
+            self.model.params, self.model.op_state, jnp.asarray(tok),
+            jnp.asarray(pos), jnp.asarray(active), step_rng,
+            jnp.int32(n_steps))
+        self.model.op_state = new_state
+        return np.asarray(toks)[:, :n_steps]

@@ -169,6 +169,11 @@ class RequestManager:
                 req.slot = slot
                 active[slot] = req
 
+    def _remaining_budget(self, req, max_seq: int) -> int:
+        limit = min(req.max_sequence_length or max_seq, max_seq)
+        return max(1, min(req.max_new_tokens - req.num_generated,
+                          limit - len(req.tokens)))
+
     # -- batch assembly ----------------------------------------------------
     @staticmethod
     def _meta_from_rows(R: int, Q: int, rows) -> BatchMeta:
@@ -230,17 +235,34 @@ class RequestManager:
                 for slot, chunk_toks, sp in rows:
                     active[slot].cache_depth = sp + len(chunk_toks)
                 continue
-            # decode step: every unfinished slot feeds its pending token
-            rows = [(req.slot, req.tokens[-1:], len(req.tokens) - 1)
-                    for req in active if req is not None and not req.finished]
-            if rows:
-                meta = self._meta_from_rows(R, 1, rows)
-                out = ifm.step(meta)                       # [R, 1] token ids
-                for slot, _toks, sp in rows:
-                    req = active[slot]
-                    req.tokens.append(int(out[slot, 0]))
-                    req.cache_depth = sp + 1
-                    self._finish_if_done(req, max_seq)
+            # decode: every unfinished slot feeds its pending token; the
+            # token-feedback loop runs fused on device (DECODE_BLOCK steps
+            # per call); EOS/length overshoot is reconciled host-side.
+            live = [req for req in active
+                    if req is not None and not req.finished]
+            if live:
+                # dynamic trip count: exactly the steps still needed, one
+                # compiled program regardless of size (engine.py)
+                block = min(
+                    max(self._remaining_budget(req, max_seq) for req in live),
+                    cfg.decode_block_steps)
+                tok = np.zeros((R,), np.int32)
+                pos = np.zeros((R,), np.int32)
+                act = np.zeros((R,), bool)
+                for req in live:
+                    tok[req.slot] = req.tokens[-1]
+                    pos[req.slot] = len(req.tokens) - 1
+                    act[req.slot] = True
+                # never scan past the KV cache end
+                block = max(1, min(block,
+                                   max_seq - 1 - int(pos[act].max())))
+                toks = ifm.decode_block(tok, pos, act, block)
+                for req in live:
+                    for j in range(block):
+                        req.tokens.append(int(toks[req.slot, j]))
+                        if self._finish_if_done(req, max_seq):
+                            break
+                    req.cache_depth = len(req.tokens) - 1
             for slot in range(R):
                 req = active[slot]
                 if req is not None and req.finished:
@@ -262,6 +284,12 @@ class RequestManager:
         tree nodes in one step; the longest root path whose every child
         matches the verifier's argmax is accepted, plus one bonus token.
         """
+        if len(ssms) == 1:
+            # MAX_BEAM_WIDTH=1 single-draft speculation (the reference
+            # default) runs fully fused on device — chains need no tree
+            # merge and no KV compaction.
+            return self._generate_spec_chain(llm, ssms[0],
+                                             spec_depth=spec_depth)
         llm_ifm = getattr(llm, "_inference_manager", None)
         if llm_ifm is None:
             llm_ifm = llm._inference_manager = InferenceManager(llm)
@@ -341,6 +369,120 @@ class RequestManager:
                 # ---- verify on the LLM ----
                 self._verify_and_commit(llm, llm_ifm, live, trees, R, T,
                                         max_seq, depth)
+            for slot in range(R):
+                req = active[slot]
+                if req is not None and req.finished:
+                    done.append(self._collect(req))
+                    active[slot] = None
+        return done
+
+    def _generate_spec_chain(self, llm, ssm,
+                             spec_depth: Optional[int] = None
+                             ) -> List[GenerationResult]:
+        """Single-SSM speculative decoding with the fused chain engine.
+
+        Each device call runs SPEC_ROUNDS_PER_CALL full rounds (draft scan +
+        verify + accept) via serve/engine.py; the host walks the returned
+        (a, n_acc) blocks, committing ``a[slot, k, :n_acc+1]`` per round and
+        reconciling EOS / length limits.
+        """
+        from flexflow_tpu.serve.engine import SpecChainEngine
+
+        llm_ifm = getattr(llm, "_inference_manager", None)
+        if llm_ifm is None:
+            llm_ifm = llm._inference_manager = InferenceManager(llm)
+        ssm_ifm = getattr(ssm, "_inference_manager", None)
+        if ssm_ifm is None:
+            ssm_ifm = ssm._inference_manager = InferenceManager(ssm)
+        cfg = llm.config
+        R = cfg.max_requests_per_batch
+        max_seq = cfg.max_sequence_length
+        depth = min(spec_depth or self.max_spec_depth, self.max_spec_depth)
+        engine = getattr(llm, "_chain_engine", None)
+        if engine is None or engine.ssm is not ssm or engine.depth != depth:
+            engine = llm._chain_engine = SpecChainEngine(
+                llm, ssm, depth, max_rounds=cfg.spec_rounds_per_call)
+        chunk = max(1, cfg.max_tokens_per_batch // max(1, min(R, 4)))
+        active: List[Optional[Request]] = [None] * R
+        done: List[GenerationResult] = []
+
+        while self.pending or any(a is not None for a in active):
+            self._fill_slots(active, max_seq, done)
+            # prompt prefill for both models (same path as incremental)
+            prefilled = False
+            for ifm, depth_of in ((llm_ifm, lambda r: r.cache_depth),
+                                  (ssm_ifm,
+                                   lambda r: r.ssm_cache_depth.get(0, 0))):
+                rows = self._prefill_rows(active, chunk, depth_of,
+                                          cfg.max_tokens_per_batch)
+                if rows:
+                    meta = self._meta_from_rows(R, chunk, rows)
+                    ifm.step(meta)
+                    for slot, toks, sp in rows:
+                        if ifm is llm_ifm:
+                            active[slot].cache_depth = sp + len(toks)
+                        else:
+                            active[slot].ssm_cache_depth[0] = sp + len(toks)
+                    prefilled = True
+            if prefilled:
+                continue
+            live = [req for req in active
+                    if req is not None and not req.finished]
+            if live:
+                # speculation must not run past the KV cache end: the verify
+                # pass writes at positions pos..pos+depth each round
+                room = min(
+                    max_seq - len(req.tokens) - 1 for req in live)
+                needed = -(-max(self._remaining_budget(req, max_seq)
+                                for req in live) // (depth + 1))
+                rounds = min(needed, cfg.spec_rounds_per_call)
+                if room < rounds * (depth + 1):
+                    rounds = max(0, room // (depth + 1))
+                if rounds == 0:
+                    # cache nearly full: finish remaining tokens one by one
+                    # through the non-fused single-step decode path
+                    rows = [(req.slot, req.tokens[-1:], len(req.tokens) - 1)
+                            for req in live]
+                    meta = self._meta_from_rows(R, 1, rows)
+                    out = llm_ifm.step(meta)
+                    for slot, _t, sp in rows:
+                        req = active[slot]
+                        req.tokens.append(int(out[slot, 0]))
+                        req.cache_depth = sp + 1
+                        req.ssm_cache_depth[0] = min(
+                            req.ssm_cache_depth.get(0, 0), sp)
+                        self._finish_if_done(req, max_seq)
+                else:
+                    tok = np.zeros((R,), np.int32)
+                    pos = np.zeros((R,), np.int32)
+                    act = np.zeros((R,), bool)
+                    for req in live:
+                        assert req.cache_depth == len(req.tokens) - 1
+                        assert req.ssm_cache_depth.get(0) == len(req.tokens) - 1
+                        tok[req.slot] = req.tokens[-1]
+                        pos[req.slot] = len(req.tokens) - 1
+                        act[req.slot] = True
+                    a, n_acc = engine.run_block(tok, pos, act, rounds)
+                    for req in live:
+                        for k in range(rounds):
+                            n = int(n_acc[req.slot, k])
+                            new_toks = [int(t)
+                                        for t in a[req.slot, k, : n + 1]]
+                            # trim the accepted chunk at the generation
+                            # budget / EOS — incremental decoding would
+                            # have stopped there (tree-path parity)
+                            room = req.max_new_tokens - req.num_generated
+                            new_toks = new_toks[:max(0, room)]
+                            if (self.eos_token_id is not None
+                                    and self.eos_token_id in new_toks):
+                                new_toks = new_toks[
+                                    :new_toks.index(self.eos_token_id) + 1]
+                            req.tokens.extend(new_toks)
+                            if self._finish_if_done(req, max_seq):
+                                break
+                        d = len(req.tokens) - 1
+                        req.cache_depth = d
+                        req.ssm_cache_depth[0] = d
             for slot in range(R):
                 req = active[slot]
                 if req is not None and req.finished:
