@@ -39,6 +39,12 @@ class FFConfig:
     expert_parallelism_degree: int = 1
 
     # --- auto-parallelization search (reference config.h:131-143) ---
+    # auto_parallel=True runs the Unity-style search at compile() and applies
+    # the found per-op shardings (reference runs graph_optimize inside
+    # FFModel::compile unconditionally; here it is opt-in so explicit
+    # dp/tp degrees remain the default path).
+    auto_parallel: bool = False
+    tpu_chip: str = "cpu-sim"           # cost-model chip: v5e|v5p|v4|cpu-sim
     only_data_parallel: bool = False
     search_budget: int = -1
     search_alpha: float = 1.2

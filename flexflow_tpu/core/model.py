@@ -63,6 +63,7 @@ class FFModel:
         self.metrics: List[MetricsType] = []
         self.mesh = None
         self.policy: Optional[ShardingPolicy] = None
+        self.strategy = None    # search/strategy.py Strategy when auto_parallel
         self._train_step = None
         self._eval_step = None
         self._perf = PerfMetrics()
@@ -573,6 +574,12 @@ class FFModel:
             ins = [values[t.tensor_id] for t in layer.inputs]
             ctx.layer_name = layer.name
             outs = impl.forward(layer.attrs, params.get(layer.name, {}), ins, ctx)
+            if self.strategy is not None and self.policy is not None:
+                strat_op = self.strategy.ops.get(layer.name)
+                if strat_op is not None and outs:
+                    outs = [self.policy.constrain(outs[0],
+                                                  strat_op.output_spec),
+                            *outs[1:]]
             for t, v in zip(layer.outputs, outs):
                 values[t.tensor_id] = v
         new_state = dict(ctx.state_in)
@@ -600,6 +607,16 @@ class FFModel:
         self.mesh = make_mesh(self.config)
         self.policy = ShardingPolicy(self.mesh)
 
+        # --- Unity-style auto-parallelization (reference model.cc:3327
+        # launches GRAPH_OPTIMIZE_TASK inside compile) ---
+        self.strategy = None
+        if self.config.auto_parallel:
+            from flexflow_tpu.search import optimize_model
+
+            self.strategy = optimize_model(
+                self, chip=self.config.tpu_chip,
+                training=(comp_mode == CompMode.COMP_MODE_TRAINING))
+
         # --- parameter + op-state init ---
         key = jax.random.PRNGKey(self.config.seed)
         params: Dict[str, Dict[str, jnp.ndarray]] = {}
@@ -607,11 +624,16 @@ class FFModel:
             if not layer.weights:
                 continue
             lp = {}
+            strat_op = (self.strategy.ops.get(layer.name)
+                        if self.strategy is not None else None)
             for w in layer.weights:
                 wkey = jax.random.fold_in(
                     key, stable_hash(layer.name, w.name))
                 arr = w.initializer(wkey, w.shape, w.dtype.to_jnp())
-                sharding = self.policy.weight_sharding(w.shape, w.sharding_dims)
+                wdims = w.sharding_dims
+                if strat_op is not None and w.name in strat_op.weight_specs:
+                    wdims = strat_op.weight_specs[w.name]
+                sharding = self.policy.weight_sharding(w.shape, wdims)
                 lp[w.name] = jax.device_put(arr, sharding)
             params[layer.name] = lp
         self.params = params

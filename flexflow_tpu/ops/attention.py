@@ -21,10 +21,15 @@ from flexflow_tpu.ops.base import OpImpl, register_op
 
 
 def mha_forward(q, k, v, params, num_heads, dropout=0.0, causal=False,
-                rng=None, training=False, add_zero_attn=False):
+                rng=None, training=False, add_zero_attn=False, mesh=None):
     """q,k,v: [batch, seq, embed]. Weights: wq/wk/wv [embed, num_heads*head_dim],
     wo [num_heads*head_dim, embed]; optional biases bq/bk/bv/bo and learnable
-    appended bias_k/bias_v rows (torch MultiheadAttention semantics)."""
+    appended bias_k/bias_v rows (torch MultiheadAttention semantics).
+
+    When `mesh` carries a "seq" axis of size > 1, the attention core runs as
+    ring attention over that axis (sequence parallelism — capability the
+    reference lacks, SURVEY §2.3/§5), provided the variant allows it
+    (self-attention shapes, no prob-dropout, no appended kv rows)."""
     b, sq, _ = q.shape
     sk = k.shape[1]
     wq, wk, wv, wo = params["wq"], params["wk"], params["wv"], params["wo"]
@@ -47,6 +52,21 @@ def mha_forward(q, k, v, params, num_heads, dropout=0.0, causal=False,
     qh = qp.reshape(b, sq, num_heads, head_dim)
     kh = kp.reshape(b, sk, num_heads, head_dim)
     vh = vp.reshape(b, sk, num_heads, head_dim)
+    use_ring = (
+        mesh is not None and "seq" in mesh.axis_names
+        and mesh.shape["seq"] > 1 and sq == sk
+        and not (training and dropout > 0.0)
+        and "bias_k" not in params and not add_zero_attn
+        and sq % mesh.shape["seq"] == 0)
+    if use_ring:
+        from flexflow_tpu.parallel.ring_attention import ring_attention
+
+        out = ring_attention(qh, kh, vh, mesh, seq_axis="seq",
+                             causal=causal).astype(q.dtype)
+        out = out.reshape(b, sq, num_heads * head_dim) @ wo
+        if "bo" in params:
+            out = out + params["bo"]
+        return out
     scores = jnp.einsum("bqhd,bkhd->bhqk", qh, kh,
                         preferred_element_type=jnp.float32)
     scores = scores / math.sqrt(head_dim)
@@ -120,5 +140,6 @@ class MultiHeadAttention(OpImpl):
             rng=ctx.layer_rng(),
             training=ctx.training,
             add_zero_attn=attrs.get("add_zero_attn", False),
+            mesh=ctx.mesh,
         )
         return [out]

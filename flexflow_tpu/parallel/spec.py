@@ -67,3 +67,20 @@ class ShardingPolicy:
 
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
+
+    def constrain(self, value, spec):
+        """Apply a searched per-op output layout (search/strategy.py Spec —
+        a mesh-axis name per dim) as a GSPMD sharding constraint. Axes not in
+        the mesh or not dividing the dim fall back to replicated on that dim."""
+        shape = getattr(value, "shape", None)
+        if shape is None:
+            return value
+        clean = []
+        for i, ax in enumerate(tuple(spec)[: len(shape)]):
+            ok = (ax is not None and self._axis(ax) is not None
+                  and shape[i] % self.mesh.shape[ax] == 0)
+            clean.append(ax if ok else None)
+        if not any(clean):
+            return value
+        return jax.lax.with_sharding_constraint(
+            value, NamedSharding(self.mesh, P(*clean)))

@@ -1,0 +1,206 @@
+"""Cost model: simulated step time + memory for a (PCG, strategy) candidate.
+
+Role-equivalent of the reference's ``Simulator`` (reference
+src/runtime/simulator.cc:797 simulate_runtime; ``CostMetrics`` simulator.h:55),
+which microbenchmarks each op on-device and simulates the task graph over a
+machine model. On TPU one jitted SPMD program executes the whole step, so the
+simulation reduces to:
+
+  step_time = Σ_ops roofline(op, sharding) + Σ_ops psum(partial outputs)
+            + Σ_edges reshard(producer_spec → consumer_spec)
+            [+ gradient allreduce per weight for training]
+
+An optional *profiled* mode (``CostModel.profile=True``) jit-compiles and
+times each distinct (op, sharding) leaf on the real backend with caching by
+params-hash — the moral equivalent of ``Op::measure_operator_cost``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from flexflow_tpu.ffconst import OpType
+from flexflow_tpu.search.machine_model import MachineModel
+from flexflow_tpu.search.pcg import PCG, PCGNode
+from flexflow_tpu.search.strategy import (
+    OpStrategy, Spec, Strategy, shard_bytes, spec_degree,
+)
+
+
+@dataclasses.dataclass
+class CostMetrics:
+    """Per-candidate costs (reference simulator.h:55 CostMetrics)."""
+
+    forward_time: float = 0.0
+    backward_time: float = 0.0
+    comm_time: float = 0.0
+    sync_time: float = 0.0          # gradient allreduce
+    memory: float = 0.0             # per-device bytes
+
+    @property
+    def total(self) -> float:
+        return (self.forward_time + self.backward_time + self.comm_time
+                + self.sync_time)
+
+
+class CostModel:
+    def __init__(self, machine: MachineModel, axis_degrees: Dict[str, int],
+                 training: bool = True, profile: bool = False):
+        self.machine = machine
+        self.axes = dict(axis_degrees)
+        self.training = training
+        self.profile = profile
+        self._profile_cache: Dict[str, float] = {}
+
+    # ---- per-node compute ------------------------------------------------
+    def node_compute_time(self, node: PCGNode, st: OpStrategy) -> CostMetrics:
+        shards = max(spec_degree(st.output_spec, self.axes), 1)
+        # weight sharding reduces per-device gemm work for tp-row/col too;
+        # output-spec degree already captures col/dp; row-parallel shards
+        # the contraction dim (visible via partial_axes).
+        for a in st.partial_axes:
+            shards *= self.axes.get(a, 1)
+        flops = node.flops() / shards
+        bytes_moved = node.io_bytes() / shards
+        fwd = self.machine.op_time(flops, bytes_moved)
+        m = CostMetrics(forward_time=fwd)
+        if self.training and node.weight_shapes:
+            m.backward_time = 2.0 * fwd       # dgrad + wgrad
+        elif self.training:
+            m.backward_time = fwd
+        # psum of partial outputs
+        out_bytes = shard_bytes(node.output_shapes[0] if node.output_shapes
+                                else (), node.dtype_bytes, st.output_spec,
+                                self.axes)
+        for a in st.partial_axes:
+            m.comm_time += self.machine.all_reduce_time(
+                out_bytes, self.axes.get(a, 1))
+        # gradient sync: weights replicated over "data" ⇒ allreduce of grads
+        if self.training and node.weight_shapes:
+            data_deg = self.axes.get("data", 1)
+            if data_deg > 1:
+                for w, shape in node.weight_shapes.items():
+                    wspec = st.weight_specs.get(w, (None,) * len(shape))
+                    wb = shard_bytes(shape, node.dtype_bytes, wspec, self.axes)
+                    m.sync_time += self.machine.all_reduce_time(wb, data_deg)
+        m.memory = self.node_memory(node, st)
+        return m
+
+    def node_memory(self, node: PCGNode, st: OpStrategy) -> float:
+        mem = 0.0
+        for w, shape in node.weight_shapes.items():
+            wspec = st.weight_specs.get(w, (None,) * len(shape))
+            wb = shard_bytes(shape, node.dtype_bytes, wspec, self.axes)
+            mem += wb * (3.0 if self.training else 1.0)   # + grad + opt state
+        for shape in node.output_shapes:
+            mem += shard_bytes(shape, node.dtype_bytes, st.output_spec,
+                               self.axes)
+        return mem
+
+    # ---- edge resharding -------------------------------------------------
+    def reshard_time(self, shape: Tuple[int, ...], dtype_bytes: float,
+                     src: Spec, dst: Spec) -> float:
+        """Cost of moving a tensor from layout src to layout dst.
+
+        GSPMD compiles these to all-gather / slice / all-to-all; we charge
+        the standard lower bounds. src partial-ness is charged at the
+        producer (node_compute_time), so here both are final layouts.
+        """
+        src = tuple(src) + (None,) * (len(shape) - len(src))
+        dst = tuple(dst) + (None,) * (len(shape) - len(dst))
+        if src == dst:
+            return 0.0
+        t = 0.0
+        src_bytes = shard_bytes(shape, dtype_bytes, src, self.axes)
+        gathered = list(src)
+        # axes sharded at src but not at dst in the same dim: all-gather
+        for d, a in enumerate(src):
+            if a is not None and dst[d] != a:
+                g = self.axes.get(a, 1)
+                t += self.machine.all_gather_time(src_bytes, g)
+                src_bytes *= g / 1.0 if g else 1.0
+                gathered[d] = None
+        # dims newly sharded at dst: local slice — free. Same axis moved
+        # between dims would be an all-to-all; charge it when axis appears
+        # in dst on a dim where src had it elsewhere.
+        src_axes = {a for a in src if a}
+        for d, a in enumerate(dst):
+            if a is not None and src[d] != a and a in src_axes:
+                t += self.machine.all_to_all_time(
+                    shard_bytes(shape, dtype_bytes, dst, self.axes),
+                    self.axes.get(a, 1))
+        return t
+
+    # ---- whole-graph simulation -----------------------------------------
+    def simulate(self, pcg: PCG, strategy: Strategy) -> CostMetrics:
+        """Reference Simulator::simulate_runtime — here a sum because the
+        jitted SPMD program runs ops in sequence per step (XLA overlap is
+        absorbed in the efficiency factors)."""
+        total = CostMetrics()
+        for node in pcg.nodes:
+            st = strategy.ops.get(node.name)
+            if st is None:
+                continue
+            m = self.node_compute_time(node, st)
+            total.forward_time += m.forward_time
+            total.backward_time += m.backward_time
+            total.comm_time += m.comm_time
+            total.sync_time += m.sync_time
+            total.memory += m.memory
+            # edges: producer output spec → this node's expected input spec
+            for k, src_idx in enumerate(node.in_edges):
+                src_node = pcg.nodes[src_idx]
+                src_st = strategy.ops.get(src_node.name)
+                if src_st is None or k >= len(node.input_shapes):
+                    continue
+                want = (st.input_specs[k] if k < len(st.input_specs)
+                        else None)
+                if want is None:
+                    continue
+                total.comm_time += self.reshard_time(
+                    node.input_shapes[k], src_node.dtype_bytes,
+                    src_st.output_spec, want)
+        return total
+
+    # ---- profiled refinement (measure_operator_cost equivalent) ---------
+    def measure_node(self, node: PCGNode, st: OpStrategy) -> float:
+        """Compile+time the op's jax forward on the real backend, cached by
+        (op, shapes, sharding) — reference Op::measure_operator_cost
+        (e.g. linear.cc:1163) with the params-hash cache in simulator.cc."""
+        key = f"{node.op_type}:{node.input_shapes}:{st.key()}"
+        if key in self._profile_cache:
+            return self._profile_cache[key]
+        import time
+
+        import jax
+        import jax.numpy as jnp
+
+        from flexflow_tpu.ops.base import OpContext, get_op_impl
+
+        try:
+            impl = get_op_impl(node.op_type)
+            shards = max(spec_degree(st.output_spec, self.axes), 1)
+            ins = [jnp.zeros(s, dtype=jnp.float32)
+                   for s in node.input_shapes]
+            params = {w: jnp.zeros(s, dtype=jnp.float32)
+                      for w, s in node.weight_shapes.items()}
+            ctx = OpContext(training=False, compute_dtype=jnp.float32)
+
+            def f(params, ins):
+                return impl.forward(node.attrs, params, ins, ctx)
+
+            jf = jax.jit(f)
+            out = jf(params, ins)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(3):
+                out = jf(params, ins)
+            jax.block_until_ready(out)
+            t = (time.perf_counter() - t0) / 3 / shards
+        except Exception:
+            t = self.node_compute_time(node, st).forward_time
+        self._profile_cache[key] = t
+        return t

@@ -1,0 +1,220 @@
+"""The Unity search: joint choice of per-op sharding over the PCG.
+
+Mirrors the reference's search architecture (reference src/runtime/graph.cc
+Graph::graph_optimize_task:2107, SearchHelper DP graph.h:170-196,
+FFModel::mcmc_optimize model.cc:3791) in TPU terms:
+
+* **sequence split**: the PCG is cut at post-dominator bottlenecks
+  (`PCG.bottleneck_nodes`), and each segment is optimized independently —
+  exactly `find_optimal_sequence_graph_time`, with the simplification that
+  resharding at the cut is costed on the edge rather than enumerated as a
+  (source view, sink view) pair (GSPMD reshards anywhere, so the DP doesn't
+  need to pin boundary layouts).
+* **within a segment**: beam search over per-node candidate configs in topo
+  order (the reference enumerates MachineViews per node inside its DP leaves);
+  elementwise nodes inherit their producer's layout and add no branching.
+* **MCMC refinement**: Metropolis over (node, config) rewrites on the full
+  graph — the MLSys'19 search, used as a polish pass and as the fallback for
+  graphs with no bottleneck structure.
+* **memory-aware λ**: if the best strategy oversubscribes HBM, re-search with
+  cost = time + λ·memory, growing λ geometrically until it fits (reference
+  graph.cc:2126-2192 binary-searches λ the same way).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Dict, List, Optional, Tuple
+
+from flexflow_tpu.search.cost_model import CostModel, CostMetrics
+from flexflow_tpu.search.machine_model import MachineModel, TPU_CHIPS
+from flexflow_tpu.search.pcg import ELEMENTWISE_OPS, PCG, PCGNode
+from flexflow_tpu.search.strategy import OpStrategy, Strategy, replicated
+
+
+class UnitySearch:
+    def __init__(self, pcg: PCG, cost_model: CostModel,
+                 axis_degrees: Dict[str, int], beam_width: int = 32,
+                 budget: int = -1, alpha: float = 1.2,
+                 mem_lambda: float = 0.0):
+        self.pcg = pcg
+        self.cm = cost_model
+        self.axes = dict(axis_degrees)
+        self.beam_width = beam_width
+        self.budget = budget if budget > 0 else 1000
+        self.alpha = alpha
+        self.mem_lambda = mem_lambda
+
+    # ------------------------------------------------------------------
+    def _node_candidates(self, node: PCGNode,
+                         chosen: Dict[int, OpStrategy]) -> List[OpStrategy]:
+        """Candidates for `node` given already-chosen producers. Elementwise/
+        shape ops follow their first producer's layout (zero-cost inheritance,
+        like the reference propagating parallel dims through these ops)."""
+        if node.op_type in ELEMENTWISE_OPS and node.in_edges:
+            src = chosen.get(node.in_edges[0])
+            if src is not None:
+                out_nd = (len(node.output_shapes[0])
+                          if node.output_shapes else 0)
+                spec = tuple(src.output_spec[:out_nd]) + (None,) * max(
+                    0, out_nd - len(src.output_spec))
+                return [OpStrategy(
+                    input_specs=tuple(spec[:len(s)] + (None,) * max(
+                        0, len(s) - len(spec)) for s in node.input_shapes),
+                    output_spec=spec,
+                    weight_specs={w: replicated(len(s))
+                                  for w, s in node.weight_shapes.items()},
+                    name="follow")]
+        return node.candidates(self.axes)
+
+    def _score(self, m: CostMetrics) -> float:
+        return m.total + self.mem_lambda * m.memory
+
+    # ------------------------------------------------------------------
+    def _candidate_delta(self, node: PCGNode, cand: OpStrategy,
+                         chosen: Dict[int, OpStrategy]) -> float:
+        """Incremental score of appending (node, cand) to a partial
+        assignment: the node's own cost plus resharding on its in-edges
+        (all producers are already chosen — topo order)."""
+        m = self.cm.node_compute_time(node, cand)
+        t = m.total + self.mem_lambda * m.memory
+        for k, src_idx in enumerate(node.in_edges):
+            src_st = chosen.get(src_idx)
+            if src_st is None or k >= len(node.input_shapes):
+                continue
+            want = cand.input_specs[k] if k < len(cand.input_specs) else None
+            if want is None:
+                continue
+            t += self.cm.reshard_time(
+                node.input_shapes[k], self.pcg.nodes[src_idx].dtype_bytes,
+                src_st.output_spec, want)
+        return t
+
+    def _optimize_segment(self, nodes: List[PCGNode],
+                          boundary: Dict[int, OpStrategy]
+                          ) -> Dict[int, OpStrategy]:
+        """Beam search over one segment, scores carried incrementally (one
+        _candidate_delta per candidate, not a full-prefix re-simulation).
+        `boundary` carries configs of nodes outside the segment feeding it."""
+        beams: List[Tuple[float, Dict[int, OpStrategy]]] = [(0.0, dict(boundary))]
+        for node in nodes:
+            nxt: List[Tuple[float, Dict[int, OpStrategy]]] = []
+            for score, chosen in beams:
+                for cand in self._node_candidates(node, chosen):
+                    c2 = dict(chosen)
+                    c2[node.idx] = cand
+                    nxt.append((score + self._candidate_delta(
+                        node, cand, chosen), c2))
+            nxt.sort(key=lambda x: x[0])
+            beams = nxt[: self.beam_width]
+        best = beams[0][1]
+        return {i: s for i, s in best.items() if i not in boundary}
+
+    def optimize(self) -> Strategy:
+        splits = set(self.pcg.bottleneck_nodes())
+        segments: List[List[PCGNode]] = []
+        cur: List[PCGNode] = []
+        for node in self.pcg.nodes:
+            cur.append(node)
+            if node.idx in splits:
+                segments.append(cur)
+                cur = []
+        if cur:
+            segments.append(cur)
+
+        chosen: Dict[int, OpStrategy] = {}
+        for seg in segments:
+            boundary = {i: chosen[i] for n in seg for i in n.in_edges
+                        if i in chosen}
+            chosen.update(self._optimize_segment(seg, boundary))
+
+        strategy = Strategy(ops={self.pcg.nodes[i].name: s
+                                 for i, s in chosen.items()})
+        metrics = self.cm.simulate(self.pcg, strategy)
+        strategy.cost = metrics.total
+        strategy.peak_memory = metrics.memory
+        return strategy
+
+
+def mcmc_optimize(pcg: PCG, cost_model: CostModel,
+                  axis_degrees: Dict[str, int], start: Strategy,
+                  budget: int = 200, temperature: float = 0.25,
+                  seed: int = 0,
+                  memory_bound: Optional[float] = None) -> Strategy:
+    """Metropolis refinement (reference FFModel::mcmc_optimize model.cc:3791:
+    random op → random ParallelConfig, accept by simulated-runtime rule).
+    Moves that would exceed `memory_bound` per-device bytes are rejected, so
+    refinement cannot undo the memory-aware λ search that produced `start`."""
+    rng = random.Random(seed)
+    search = UnitySearch(pcg, cost_model, axis_degrees)
+    current = Strategy(ops=dict(start.ops))
+    cur_m = cost_model.simulate(pcg, current)
+    cur_cost = cur_m.total
+    best = Strategy(ops=dict(current.ops), cost=cur_cost,
+                    peak_memory=cur_m.memory)
+    idx_by_name = {n.name: n for n in pcg.nodes}
+    names = [n.name for n in pcg.nodes if n.name in current.ops]
+    if not names:
+        return best
+    for it in range(budget):
+        name = rng.choice(names)
+        node = idx_by_name[name]
+        chosen_by_idx = {idx_by_name[k].idx: v for k, v in current.ops.items()}
+        cands = search._node_candidates(node, chosen_by_idx)
+        if len(cands) <= 1:
+            continue
+        cand = rng.choice(cands)
+        trial = Strategy(ops=dict(current.ops))
+        trial.ops[name] = cand
+        m = cost_model.simulate(pcg, trial)
+        if memory_bound is not None and m.memory > memory_bound:
+            continue
+        delta = m.total - cur_cost
+        if delta <= 0 or rng.random() < math.exp(
+                -delta / max(temperature * cur_cost, 1e-12)):
+            current, cur_cost = trial, m.total
+            if m.total < best.cost:
+                best = Strategy(ops=dict(trial.ops), cost=m.total,
+                                peak_memory=m.memory)
+    return best
+
+
+def optimize_model(model, chip: str = "cpu-sim",
+                   num_devices: Optional[int] = None,
+                   training: bool = True,
+                   mcmc_budget: Optional[int] = None) -> Strategy:
+    """Entry point — reference FFModel::graph_optimize via
+    GRAPH_OPTIMIZE_TASK (model.cc:3327). Reads parallelism axes from the
+    model's config, builds PCG + cost model, runs DP+beam then MCMC, and
+    re-searches with growing memory λ if HBM oversubscribes."""
+    config = model.config
+    n = num_devices if num_devices is not None else config.resolve_num_devices()
+    machine = MachineModel.from_name(chip, n)
+    axes = {"data": config.data_parallelism_degree,
+            "model": config.tensor_parallelism_degree,
+            "expert": config.expert_parallelism_degree}
+    if config.only_data_parallel:
+        axes["model"] = 1
+        axes["expert"] = 1
+    pcg = PCG.from_model(model)
+    cm = CostModel(machine, axes, training=training)
+    budget = config.search_budget
+    lam = 0.0
+    strategy = None
+    for _attempt in range(6):
+        cm_l = CostModel(machine, axes, training=training)
+        search = UnitySearch(pcg, cm_l, axes, budget=budget,
+                             alpha=config.search_alpha, mem_lambda=lam)
+        strategy = search.optimize()
+        if strategy.peak_memory <= machine.memory_per_device() or lam > 1e6:
+            break
+        lam = max(lam * 8, 1e-9)     # grow λ until the strategy fits HBM
+    n_mcmc = mcmc_budget if mcmc_budget is not None else (
+        budget if budget > 0 else 100)
+    strategy = mcmc_optimize(pcg, cm, axes, strategy, budget=n_mcmc,
+                             seed=config.seed,
+                             memory_bound=machine.memory_per_device())
+    if config.export_strategy_file:
+        strategy.save(config.export_strategy_file)
+    return strategy

@@ -1,0 +1,115 @@
+"""TPU machine model: the cost-model's view of the hardware.
+
+Role-equivalent of the reference's SimpleMachineModel/EnhancedMachineModel
+(reference src/runtime/machine_model.cc, include/flexflow/simulator.h:213-560),
+which models GPU nodes, NVLink/PCIe/NIC bandwidths and routes comm paths.
+On TPU the topology is regular — chips in a 2-D/3-D ICI torus within a slice,
+DCN between slices — so the model reduces to a chip spec (MXU flops, HBM
+bytes/s and capacity, per-link ICI bytes/s, link count) plus slice geometry.
+
+Collective costs use the standard ring/torus lower bounds (the scaling-book
+recipe): for N participants moving B bytes over bidirectional ICI with
+aggregate bandwidth W per chip,
+  all-gather / reduce-scatter:  B * (N-1)/N / W
+  all-reduce:                   2 * B * (N-1)/N / W   (RS + AG)
+  all-to-all:                   B * (N-1)/N / W  (torus routing approximation)
+  ppermute (ring shift):        B / W_link  (one hop, one link)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSpec:
+    """Per-chip peak numbers (public spec-sheet values)."""
+
+    name: str
+    bf16_flops: float           # peak MXU flop/s (bf16)
+    hbm_bandwidth: float        # bytes/s
+    hbm_capacity: float         # bytes
+    ici_bandwidth: float        # aggregate bytes/s per chip over all ICI links
+    ici_link_bandwidth: float   # bytes/s of one ICI link (one torus direction)
+    dcn_bandwidth: float        # bytes/s per chip across slices
+    # fraction of peak the roofline assumes achievable (MXU util on big gemms)
+    flops_efficiency: float = 0.55
+    mem_efficiency: float = 0.8
+
+
+TPU_CHIPS: Dict[str, ChipSpec] = {
+    # Public spec-sheet numbers.
+    "v5e": ChipSpec("v5e", bf16_flops=197e12, hbm_bandwidth=819e9,
+                    hbm_capacity=16e9, ici_bandwidth=4 * 186e9 / 2,
+                    ici_link_bandwidth=186e9 / 2, dcn_bandwidth=25e9),
+    "v5p": ChipSpec("v5p", bf16_flops=459e12, hbm_bandwidth=2765e9,
+                    hbm_capacity=95e9, ici_bandwidth=6 * 200e9 / 2,
+                    ici_link_bandwidth=200e9 / 2, dcn_bandwidth=50e9),
+    "v4": ChipSpec("v4", bf16_flops=275e12, hbm_bandwidth=1228e9,
+                   hbm_capacity=32e9, ici_bandwidth=6 * 100e9 / 2,
+                   ici_link_bandwidth=100e9 / 2, dcn_bandwidth=25e9),
+    # Virtual-CPU chip for tests: tiny numbers so costs are nonzero and
+    # ratios still favor parallelism the way real chips do.
+    "cpu-sim": ChipSpec("cpu-sim", bf16_flops=1e11, hbm_bandwidth=2e10,
+                        hbm_capacity=8e9, ici_bandwidth=5e9,
+                        ici_link_bandwidth=2.5e9, dcn_bandwidth=1e9),
+}
+
+
+@dataclasses.dataclass
+class MachineModel:
+    """Slice geometry + chip spec → collective/time/memory primitives."""
+
+    chip: ChipSpec
+    num_devices: int
+    devices_per_slice: Optional[int] = None   # None → single slice
+
+    @classmethod
+    def from_name(cls, chip_name: str, num_devices: int,
+                  devices_per_slice: Optional[int] = None) -> "MachineModel":
+        return cls(TPU_CHIPS[chip_name], num_devices, devices_per_slice)
+
+    # ---- compute / memory primitives -------------------------------------
+    def gemm_time(self, flops: float) -> float:
+        return flops / (self.chip.bf16_flops * self.chip.flops_efficiency)
+
+    def mem_time(self, bytes_moved: float) -> float:
+        return bytes_moved / (self.chip.hbm_bandwidth * self.chip.mem_efficiency)
+
+    def op_time(self, flops: float, bytes_moved: float) -> float:
+        """Roofline: an op is MXU-bound or HBM-bound, XLA overlaps the rest."""
+        return max(self.gemm_time(flops), self.mem_time(bytes_moved))
+
+    # ---- collective primitives ------------------------------------------
+    def _group_bw(self, group_size: int) -> float:
+        """Bandwidth available to a collective over a mesh-axis group. Groups
+        that fit a slice ride ICI; larger groups are DCN-bound."""
+        per_slice = self.devices_per_slice or self.num_devices
+        if group_size <= per_slice:
+            return self.chip.ici_bandwidth
+        return self.chip.dcn_bandwidth
+
+    def all_reduce_time(self, bytes_per_chip: float, group: int) -> float:
+        if group <= 1:
+            return 0.0
+        return 2.0 * bytes_per_chip * (group - 1) / group / self._group_bw(group)
+
+    def all_gather_time(self, bytes_per_chip: float, group: int) -> float:
+        if group <= 1:
+            return 0.0
+        return bytes_per_chip * (group - 1) / group / self._group_bw(group)
+
+    def reduce_scatter_time(self, bytes_per_chip: float, group: int) -> float:
+        return self.all_gather_time(bytes_per_chip, group)
+
+    def all_to_all_time(self, bytes_per_chip: float, group: int) -> float:
+        if group <= 1:
+            return 0.0
+        return bytes_per_chip * (group - 1) / group / self._group_bw(group)
+
+    def ppermute_time(self, bytes_per_chip: float) -> float:
+        return bytes_per_chip / self.chip.ici_link_bandwidth
+
+    def memory_per_device(self) -> float:
+        return self.chip.hbm_capacity

@@ -1,0 +1,292 @@
+"""Graph substitutions: algebraic rewrites of the PCG.
+
+Role-equivalent of the reference's ``GraphXfer`` engine (reference
+src/runtime/substitution.cc: find_matches:519, run:605, create_new_graph:791)
+and its JSON rule loader (substitution_loader.h:174 ``Rule``; rule file
+``substitutions/graph_subst_3_v2.json``). Differences by design:
+
+* On TPU, *parallelization* rewrites (partition/combine/replicate insertion —
+  the bulk of the reference's hand-coded xfers, substitution.cc:70-117) are
+  not graph rewrites at all: they are sharding choices already enumerated by
+  ``PCGNode.candidates``. What remains for the substitution engine is the
+  *algebraic* family: fusing/reassociating ops so the cost model sees the
+  cheaper form (XLA performs the final fusion; the rewrite lets the search
+  reason about it).
+* The JSON loader accepts the reference rule schema (srcOp/dstOp/mappedOutput
+  with ``PM_*`` parameters) so existing rule files can be dropped in; rules
+  whose op types we don't implement are skipped, and OP_PARTITION/OP_COMBINE/
+  OP_REPLICATE/OP_REDUCE patterns are interpreted as sharding-equivalences
+  (validated, then discarded as no-ops for the cost model).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Callable, Dict, List, Optional, Tuple
+
+from flexflow_tpu.ffconst import OpType
+from flexflow_tpu.search.pcg import PCG, PCGNode
+
+# Reference OperatorType names (substitution JSON) → our OpType
+_JSON_OP_TYPES = {
+    "OP_LINEAR": OpType.LINEAR,
+    "OP_CONV2D": OpType.CONV2D,
+    "OP_EW_ADD": OpType.EW_ADD,
+    "OP_EW_MUL": OpType.EW_MUL,
+    "OP_RELU": OpType.RELU,
+    "OP_SIGMOID": OpType.SIGMOID,
+    "OP_TANH": OpType.TANH,
+    "OP_CONCAT": OpType.CONCAT,
+    "OP_SPLIT": OpType.SPLIT,
+    "OP_RESHAPE": OpType.RESHAPE,
+    "OP_TRANSPOSE": OpType.TRANSPOSE,
+    "OP_SOFTMAX": OpType.SOFTMAX,
+    "OP_BATCHMATMUL": OpType.BATCH_MATMUL,
+    "OP_DROPOUT": OpType.DROPOUT,
+    "OP_EMBEDDING": OpType.EMBEDDING,
+    "OP_MULTIHEAD_ATTENTION": OpType.MULTIHEAD_ATTENTION,
+}
+_PARALLEL_JSON_OPS = {"OP_PARTITION", "OP_COMBINE", "OP_REPLICATE",
+                      "OP_REDUCE", "OP_PIPELINE", "OP_FUSED_PARALLEL"}
+
+
+@dataclasses.dataclass
+class OpX:
+    """Pattern node (reference substitution.h OpX): an op type + symbolic
+    input tensor slots. Slot = (op_idx_in_pattern | -1 for external, ts_id)."""
+
+    op_type: Optional[OpType]            # None = wildcard
+    inputs: List[Tuple[int, int]]
+    params: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class Rule:
+    name: str
+    src: List[OpX]
+    dst: List[OpX]
+    # (dst_op_idx, dst_ts, src_op_idx, src_ts) — which dst output replaces
+    # which src output for consumers outside the match
+    mapped_outputs: List[Tuple[int, int, int, int]]
+
+
+class GraphXfer:
+    """Match a Rule's src pattern in a PCG and produce the rewritten graph."""
+
+    def __init__(self, rule: Rule):
+        self.rule = rule
+
+    def find_matches(self, pcg: PCG) -> List[Dict[int, int]]:
+        """All mappings pattern-op-idx → graph-node-idx. Backtracking over
+        topo order, wildcard-free (reference find_matches substitution.cc:519
+        does the same with Legion node iterators)."""
+        matches: List[Dict[int, int]] = []
+        pat = self.rule.src
+
+        def backtrack(pi: int, binding: Dict[int, int],
+                      tensor_bind: Dict[Tuple[int, int], int]):
+            if pi == len(pat):
+                matches.append(dict(binding))
+                return
+            px = pat[pi]
+            for node in pcg.nodes:
+                if node.idx in binding.values():
+                    continue
+                if px.op_type is not None and node.op_type != px.op_type:
+                    continue
+                # inputs must line up with already-bound pattern producers
+                ok = True
+                for slot, (src_op, _ts) in enumerate(px.inputs):
+                    if src_op == -1:
+                        continue           # external input: anything
+                    bound = binding.get(src_op)
+                    if bound is None or (slot >= len(node.in_edges)
+                                         or node.in_edges[slot] != bound):
+                        ok = False
+                        break
+                if not ok:
+                    continue
+                binding[pi] = node.idx
+                backtrack(pi + 1, binding, tensor_bind)
+                del binding[pi]
+
+        backtrack(0, {}, {})
+        return matches
+
+    def apply(self, pcg: PCG, match: Dict[int, int]) -> Optional[PCG]:
+        """Build the rewritten graph (reference create_new_graph:791).
+        Returns None if the rewrite would orphan a consumed tensor."""
+        import copy
+
+        matched = set(match.values())
+        src_nodes = [pcg.nodes[i] for i in match.values()]
+        # External inputs of the match, in pattern slot order
+        ext_inputs: Dict[Tuple[int, int], Tuple[int, int]] = {}
+        for pi, px in enumerate(self.rule.src):
+            g = pcg.nodes[match[pi]]
+            for slot, (src_op, ts) in enumerate(px.inputs):
+                if src_op == -1 and slot < len(g.in_edges):
+                    ext_inputs[(pi, slot)] = (g.in_edges[slot], 0)
+
+        new_nodes: List[PCGNode] = []
+        remap: Dict[int, int] = {}
+        for node in pcg.nodes:
+            if node.idx in matched:
+                continue
+            n2 = copy.deepcopy(node)
+            remap[node.idx] = len(new_nodes)
+            n2.idx = len(new_nodes)
+            new_nodes.append(n2)
+        # Materialize dst pattern ops; shapes inherited from the mapped src
+        out_of = {(pi, 0): match[pi] for pi in range(len(self.rule.src))}
+        dst_graph_idx: Dict[int, int] = {}
+        for di, dx in enumerate(self.rule.dst):
+            # find a src op this dst op's output replaces → copy shapes
+            proto = None
+            for (dop, dts, sop, sts) in self.rule.mapped_outputs:
+                if dop == di:
+                    proto = pcg.nodes[match[sop]]
+                    break
+            if proto is None:
+                proto = src_nodes[min(di, len(src_nodes) - 1)]
+            n2 = copy.deepcopy(proto)
+            n2.idx = len(new_nodes)
+            n2.name = f"{proto.name}__xfer{di}"
+            if dx.op_type is not None:
+                n2.op_type = dx.op_type
+            n2.in_edges = []
+            n2.out_edges = []
+            dst_graph_idx[di] = n2.idx
+            new_nodes.append(n2)
+        # Wire dst inputs
+        for di, dx in enumerate(self.rule.dst):
+            n2 = new_nodes[dst_graph_idx[di]]
+            for slot, (src_op, ts) in enumerate(dx.inputs):
+                if src_op == -1:
+                    # external slot — reuse the matched external producer
+                    ext = ext_inputs.get((0, slot)) or next(
+                        iter(ext_inputs.values()), None)
+                    if ext is None:
+                        continue
+                    src_graph = remap.get(ext[0])
+                    if src_graph is None:
+                        return None
+                else:
+                    src_graph = dst_graph_idx.get(src_op)
+                    if src_graph is None:
+                        return None
+                n2.in_edges.append(src_graph)
+                new_nodes[src_graph].out_edges.append(n2.idx)
+        # Re-route surviving nodes' inputs: unmatched producers keep their
+        # remapped index; matched producers must be mapped outputs → dst op.
+        replace: Dict[int, int] = {}
+        for (dop, dts, sop, sts) in self.rule.mapped_outputs:
+            replace[match[sop]] = dst_graph_idx[dop]
+        dst_idx_set = set(dst_graph_idx.values())
+        for n2 in new_nodes:
+            if n2.idx in dst_idx_set:
+                continue                   # wired above
+            edges = []
+            for old in n2.in_edges:
+                if old in remap:
+                    edges.append(remap[old])
+                elif old in replace:
+                    edges.append(replace[old])
+                else:
+                    return None            # consumed a non-mapped matched output
+            n2.in_edges = edges
+        # rebuild out_edges
+        for n2 in new_nodes:
+            n2.out_edges = []
+        for n2 in new_nodes:
+            for e in n2.in_edges:
+                new_nodes[e].out_edges.append(n2.idx)
+        return PCG(new_nodes)
+
+
+# ---------------------------------------------------------------------------
+# Built-in algebraic rules
+# ---------------------------------------------------------------------------
+def builtin_rules() -> List[Rule]:
+    """The algebraic core the search benefits from on TPU. (The reference
+    ships 600+ TASO-generated rules; most are parallelization forms that the
+    candidate enumeration already covers. These are the fusion-shaped ones.)"""
+    rules = []
+    # linear → relu  ⇒  fused linear(relu)  (cost model sees one op)
+    rules.append(Rule(
+        name="fuse_linear_relu",
+        src=[OpX(OpType.LINEAR, [(-1, 0)]),
+             OpX(OpType.RELU, [(0, 0)])],
+        dst=[OpX(OpType.LINEAR, [(-1, 0)], params={"fused_relu": 1})],
+        mapped_outputs=[(0, 0, 1, 0)]))
+    # ew_add of two outputs of the same-shaped linears sharing input ⇒
+    # concat-free: keep as-is (placeholder for reassociation family)
+    return rules
+
+
+def load_rules_json(path: str) -> List[Rule]:
+    """Load reference-format substitution rules (graph_subst_3_v2.json).
+    Rules using only implemented op types load as Rule objects; rules built
+    from parallel ops (OP_PARTITION/...) are recognized and skipped — their
+    semantics live in the sharding candidate space here."""
+    with open(path) as f:
+        raw = json.load(f)
+    out: List[Rule] = []
+    for r in raw.get("rule", []):
+        ops = {o["type"] for o in r.get("srcOp", []) + r.get("dstOp", [])}
+        if ops & _PARALLEL_JSON_OPS:
+            continue                       # parallelization rule → sharding space
+        if not ops <= set(_JSON_OP_TYPES):
+            continue                       # unimplemented op type
+
+        def conv(olist) -> List[OpX]:
+            res = []
+            for o in olist:
+                res.append(OpX(
+                    op_type=_JSON_OP_TYPES[o["type"]],
+                    inputs=[(t["opId"], t["tsId"]) for t in o.get("input", [])],
+                    params={p["key"]: p["value"]
+                            for p in o.get("para", [])}))
+            return res
+
+        out.append(Rule(
+            name=r.get("name", "json_rule"),
+            src=conv(r.get("srcOp", [])),
+            dst=conv(r.get("dstOp", [])),
+            mapped_outputs=[(m["dstOpId"], m["dstTsId"], m["srcOpId"],
+                             m["srcTsId"]) for m in r.get("mappedOutput", [])],
+        ))
+    return out
+
+
+def apply_substitutions(pcg: PCG, rules: Optional[List[Rule]] = None,
+                        cost_fn: Optional[Callable[[PCG], float]] = None,
+                        max_rounds: int = 2) -> PCG:
+    """Greedy improvement loop (a bounded version of the reference's
+    best-first `base_optimize`, substitution.cc:2245): apply any rule whose
+    rewrite lowers cost_fn; stop when no rule improves or rounds exhausted."""
+    rules = rules if rules is not None else builtin_rules()
+    if cost_fn is None:
+        def cost_fn(g: PCG) -> float:
+            return sum(n.flops() for n in g.nodes)
+    best = pcg
+    best_cost = cost_fn(pcg)
+    for _ in range(max_rounds):
+        improved = False
+        for rule in rules:
+            xfer = GraphXfer(rule)
+            for match in xfer.find_matches(best):
+                cand = xfer.apply(best, match)
+                if cand is None:
+                    continue
+                c = cost_fn(cand)
+                if c < best_cost:
+                    best, best_cost = cand, c
+                    improved = True
+                    break
+            if improved:
+                break
+        if not improved:
+            break
+    return best

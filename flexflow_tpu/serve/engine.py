@@ -36,19 +36,27 @@ from flexflow_tpu.ops.base import OpContext
 from flexflow_tpu.serve.batch_config import BatchMeta
 
 
+def forward_with_meta(model, params, state, meta, rng, compute_dtype):
+    """One serving forward over a BatchMeta inside jit — the single traced
+    body shared by InferenceManager.step and the fused engines (one place to
+    maintain feed construction / position offsets)."""
+    ctx = OpContext(training=False, rng=rng, compute_dtype=compute_dtype,
+                    batch_config=meta, mesh=model.mesh, config=model.config)
+    feeds = {model.input_tensors[0].tensor_id: meta.tokens}
+    pos_t = getattr(model, "position_input_tensor", None)
+    if pos_t is not None:
+        feeds[pos_t.tensor_id] = (meta.positions
+                                  + getattr(model, "position_offset", 0))
+    values, new_state = model._run_graph(params, feeds, ctx, state)
+    return values[model._final_tensor.tensor_id], new_state
+
+
 def _forward_tokens(model, params, state, tokens, positions, start_pos,
                     num_tokens, active, rng, compute_dtype):
     """One forward over [R, Q] tokens inside jit; returns (out, new_state)."""
     meta = BatchMeta(tokens=tokens, positions=positions, start_pos=start_pos,
                      num_tokens=num_tokens, active=active)
-    ctx = OpContext(training=False, rng=rng, compute_dtype=compute_dtype,
-                    batch_config=meta, mesh=model.mesh, config=model.config)
-    feeds = {model.input_tensors[0].tensor_id: tokens}
-    pos_t = getattr(model, "position_input_tensor", None)
-    if pos_t is not None:
-        feeds[pos_t.tensor_id] = positions + model.position_offset
-    values, new_state = model._run_graph(params, feeds, ctx, state)
-    return values[model._final_tensor.tensor_id], new_state
+    return forward_with_meta(model, params, state, meta, rng, compute_dtype)
 
 
 def make_decode_block(model, compute_dtype, max_steps: int):

@@ -35,19 +35,10 @@ class InferenceManager:
         self._decode_block = None
 
     def _step_impl(self, params, op_state, meta, rng):
-        model = self.model
-        ctx = OpContext(training=False, rng=rng,
-                        compute_dtype=self._compute_dtype,
-                        batch_config=meta, mesh=model.mesh,
-                        config=model.config)
-        feeds = {model.input_tensors[0].tensor_id: meta.tokens}
-        pos_t = getattr(model, "position_input_tensor", None)
-        if pos_t is not None:
-            feeds[pos_t.tensor_id] = (
-                meta.positions + getattr(model, "position_offset", 0))
-        values, new_state = model._run_graph(params, feeds, ctx, op_state)
-        out_tokens = values[model._final_tensor.tensor_id]
-        return out_tokens, new_state
+        from flexflow_tpu.serve.engine import forward_with_meta
+
+        return forward_with_meta(self.model, params, op_state, meta, rng,
+                                 self._compute_dtype)
 
     def step(self, meta):
         """Run one serving step; threads the model's KV caches through.

@@ -415,6 +415,13 @@ class RequestManager:
                                    lambda r: r.ssm_cache_depth.get(0, 0))):
                 rows = self._prefill_rows(active, chunk, depth_of,
                                           cfg.max_tokens_per_batch)
+                if ifm is ssm_ifm:
+                    # Catching the SSM cache up is only useful if the request
+                    # can still draft (a full round of depth+1 KV slots left);
+                    # tail tokens go through the single-step fallback anyway.
+                    rows = [(slot, toks, sp) for slot, toks, sp in rows
+                            if max_seq - len(active[slot].tokens) - 1
+                            >= depth + 1]
                 if rows:
                     meta = self._meta_from_rows(R, chunk, rows)
                     ifm.step(meta)
@@ -435,7 +442,8 @@ class RequestManager:
                     max_seq - len(req.tokens) - 1 for req in live)
                 needed = -(-max(self._remaining_budget(req, max_seq)
                                 for req in live) // (depth + 1))
-                rounds = min(needed, cfg.spec_rounds_per_call)
+                rounds = min(needed, cfg.spec_rounds_per_call,
+                             engine.max_rounds)
                 if room < rounds * (depth + 1):
                     rounds = max(0, room // (depth + 1))
                 if rounds == 0:

@@ -1,0 +1,280 @@
+"""Unity auto-parallelization search tests.
+
+Covers: PCG construction + bottleneck splits, candidate enumeration, cost
+model ordering (TP beats replicated for big gemms; resharding costed),
+DP+beam+MCMC end-to-end search, memory-aware λ, strategy (de)serialization,
+substitution engine (match/apply + reference-format JSON loader), and
+compile() integration: an auto_parallel model trains on the 8-device mesh
+with the searched shardings actually applied.
+
+Reference equivalents: tests/unit/test_dominators.cc, test_machine_view.cc,
+test_substitution_loader.cc (SURVEY §4) — plus the search-quality assertions
+the reference lacks.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.ffconst import DataType, LossType, MetricsType, OpType
+from flexflow_tpu.search import (
+    CostModel, MachineModel, PCG, Strategy, UnitySearch, mcmc_optimize,
+    optimize_model,
+)
+from flexflow_tpu.search.pcg import PCGNode
+from flexflow_tpu.search.strategy import OpStrategy
+from flexflow_tpu.search.substitution import (
+    GraphXfer, apply_substitutions, builtin_rules, load_rules_json,
+)
+
+
+def mlp_model(batch=32, hidden=512, tp=1, dp=1, auto=False):
+    cfg = ff.FFConfig(batch_size=batch, tensor_parallelism_degree=tp,
+                      data_parallelism_degree=dp, auto_parallel=auto)
+    model = ff.FFModel(cfg)
+    t = model.create_tensor([batch, 64], ff.DataType.DT_FLOAT)
+    x = model.dense(t, hidden, ff.ActiMode.AC_MODE_RELU)
+    x = model.dense(x, hidden, ff.ActiMode.AC_MODE_RELU)
+    x = model.dense(x, 8)
+    model.softmax(x)
+    return model
+
+
+# ---------------------------------------------------------------------------
+# PCG structure
+# ---------------------------------------------------------------------------
+def test_pcg_from_model_edges_and_splits():
+    model = mlp_model()
+    pcg = PCG.from_model(model)
+    assert len(pcg.nodes) == 4
+    # chain: each node feeds the next -> every position is a split point
+    assert pcg.nodes[1].in_edges == [0]
+    assert pcg.nodes[3].in_edges == [2]
+    assert pcg.bottleneck_nodes() == [0, 1, 2]
+
+
+def test_pcg_residual_blocks_split_points():
+    """A residual skip edge must suppress split points under it."""
+    cfg = ff.FFConfig(batch_size=8)
+    model = ff.FFModel(cfg)
+    t = model.create_tensor([8, 32], ff.DataType.DT_FLOAT)
+    h1 = model.dense(t, 32)          # node 0
+    h2 = model.dense(h1, 32)         # node 1
+    s = model.add(h1, h2)            # node 2 — consumes node 0 AND node 1
+    model.dense(s, 32)               # node 3
+    pcg = PCG.from_model(model)
+    splits = pcg.bottleneck_nodes()
+    assert 1 not in splits           # edge 0->2 crosses the cut after node 1
+    assert 0 in splits and 2 in splits
+
+
+def test_linear_candidates_cover_megatron_forms():
+    model = mlp_model()
+    pcg = PCG.from_model(model)
+    node = pcg.nodes[0]
+    cands = node.candidates({"data": 2, "model": 4})
+    names = {c.name for c in cands}
+    assert {"replicate", "dp", "tp-col", "tp-row",
+            "tp-col+dp", "tp-row+dp"} <= names
+    col = next(c for c in cands if c.name == "tp-col")
+    assert col.weight_specs["kernel"] == (None, "model")
+    assert col.output_spec[-1] == "model"
+    row = next(c for c in cands if c.name == "tp-row")
+    assert row.partial_axes == ("model",)
+    assert row.weight_specs["kernel"] == ("model", None)
+
+
+# ---------------------------------------------------------------------------
+# Cost model
+# ---------------------------------------------------------------------------
+def test_cost_model_prefers_sharding_big_gemm():
+    machine = MachineModel.from_name("v5e", 8)
+    axes = {"data": 2, "model": 4}
+    cm = CostModel(machine, axes, training=True)
+    node = PCGNode(idx=0, name="big", op_type=OpType.LINEAR,
+                   input_shapes=[(4096, 8192)], output_shapes=[(4096, 8192)],
+                   weight_shapes={"kernel": (8192, 8192)},
+                   dtype=DataType.DT_FLOAT)
+    cands = node.candidates(axes)
+    by_name = {c.name: cm.node_compute_time(node, c) for c in cands}
+    assert by_name["tp-col+dp"].total < by_name["replicate"].total
+    assert by_name["dp"].total < by_name["replicate"].total
+    # memory: sharded weights take less HBM
+    assert by_name["tp-col"].memory < by_name["replicate"].memory
+
+
+def test_reshard_cost_zero_for_same_spec_and_positive_for_gather():
+    machine = MachineModel.from_name("v5e", 8)
+    cm = CostModel(machine, {"data": 2, "model": 4})
+    shape = (1024, 1024)
+    assert cm.reshard_time(shape, 4, ("data", None), ("data", None)) == 0.0
+    g = cm.reshard_time(shape, 4, (None, "model"), (None, None))
+    assert g > 0.0
+    # collective cost scales with bytes
+    g2 = cm.reshard_time((2048, 1024), 4, (None, "model"), (None, None))
+    assert g2 > g
+
+
+def test_allreduce_time_monotone_in_group():
+    m = MachineModel.from_name("v5p", 16)
+    t2 = m.all_reduce_time(1e9, 2)
+    t8 = m.all_reduce_time(1e9, 8)
+    assert 0 < t2 < t8
+    assert m.all_reduce_time(1e9, 1) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Search end-to-end
+# ---------------------------------------------------------------------------
+def test_unity_search_finds_tp_for_tall_mlp():
+    """With a 'model' axis available and a gemm-dominated graph, the search
+    must beat pure replication and produce a valid full assignment."""
+    model = mlp_model(batch=32, hidden=2048)
+    pcg = PCG.from_model(model)
+    axes = {"data": 2, "model": 4}
+    machine = MachineModel.from_name("v5e", 8)
+    cm = CostModel(machine, axes, training=True)
+    search = UnitySearch(pcg, cm, axes)
+    strategy = search.optimize()
+    assert set(strategy.ops) == {n.name for n in pcg.nodes}
+    # replicated-everything baseline
+    repl = Strategy(ops={
+        n.name: OpStrategy(
+            input_specs=tuple((None,) * len(s) for s in n.input_shapes),
+            output_spec=(None,) * len(n.output_shapes[0]),
+            weight_specs={w: (None,) * len(s)
+                          for w, s in n.weight_shapes.items()})
+        for n in pcg.nodes})
+    assert strategy.cost < cm.simulate(pcg, repl).total
+    # searched strategy uses some parallel axis on the big linears
+    used = [s.name for s in strategy.ops.values()]
+    assert any(n != "replicate" for n in used)
+
+
+def test_mcmc_never_worse_than_start():
+    model = mlp_model(batch=32, hidden=256)
+    pcg = PCG.from_model(model)
+    axes = {"data": 2, "model": 4}
+    cm = CostModel(MachineModel.from_name("v5e", 8), axes)
+    search = UnitySearch(pcg, cm, axes)
+    start = search.optimize()
+    refined = mcmc_optimize(pcg, cm, axes, start, budget=50, seed=3)
+    assert refined.cost <= start.cost + 1e-12
+
+
+def test_memory_lambda_shrinks_footprint():
+    """When HBM is tiny, the λ re-search must pick a lower-memory strategy
+    (reference graph.cc:2126 memory-aware λ binary search)."""
+    model = mlp_model(batch=32, hidden=1024)
+    pcg = PCG.from_model(model)
+    axes = {"data": 2, "model": 4}
+    big = CostModel(MachineModel.from_name("v5e", 8), axes)
+    free = UnitySearch(pcg, big, axes, mem_lambda=0.0).optimize()
+    tight = UnitySearch(pcg, big, axes, mem_lambda=1.0).optimize()
+    assert tight.peak_memory <= free.peak_memory
+
+
+def test_strategy_json_roundtrip(tmp_path):
+    model = mlp_model()
+    pcg = PCG.from_model(model)
+    axes = {"data": 2, "model": 4}
+    cm = CostModel(MachineModel.from_name("v5e", 8), axes)
+    st = UnitySearch(pcg, cm, axes).optimize()
+    p = tmp_path / "strategy.json"
+    st.save(str(p))
+    st2 = Strategy.load(str(p))
+    assert st2.ops.keys() == st.ops.keys()
+    for k in st.ops:
+        assert st2.ops[k].output_spec == st.ops[k].output_spec
+        assert st2.ops[k].weight_specs == st.ops[k].weight_specs
+        assert st2.ops[k].partial_axes == st.ops[k].partial_axes
+
+
+# ---------------------------------------------------------------------------
+# Substitutions
+# ---------------------------------------------------------------------------
+def test_substitution_fuse_linear_relu():
+    cfg = ff.FFConfig(batch_size=8)
+    model = ff.FFModel(cfg)
+    t = model.create_tensor([8, 32], ff.DataType.DT_FLOAT)
+    x = model.dense(t, 32)           # LINEAR (no fused activation)
+    model.relu(x)                    # RELU
+    pcg = PCG.from_model(model)
+    rule = builtin_rules()[0]
+    xfer = GraphXfer(rule)
+    matches = xfer.find_matches(pcg)
+    assert len(matches) == 1
+    new = xfer.apply(pcg, matches[0])
+    assert new is not None
+    assert len(new.nodes) == 1
+    assert new.nodes[0].op_type == OpType.LINEAR
+
+
+def test_apply_substitutions_lowers_node_count():
+    cfg = ff.FFConfig(batch_size=8)
+    model = ff.FFModel(cfg)
+    t = model.create_tensor([8, 32], ff.DataType.DT_FLOAT)
+    x = model.dense(t, 32)
+    x = model.relu(x)
+    x = model.dense(x, 32)
+    model.relu(x)
+    pcg = PCG.from_model(model)
+    out = apply_substitutions(pcg, cost_fn=lambda g: len(g.nodes),
+                              max_rounds=4)
+    assert len(out.nodes) < len(pcg.nodes)
+
+
+def test_reference_json_rules_load():
+    """The reference's shipped rule file parses; parallel-op rules are
+    recognized and mapped into the sharding space (skipped as rewrites)."""
+    path = "/root/reference/substitutions/graph_subst_3_v2.json"
+    if not os.path.exists(path):
+        pytest.skip("reference rules not mounted")
+    rules = load_rules_json(path)
+    assert isinstance(rules, list)      # loads without error; subset usable
+    for r in rules:
+        assert r.src and r.dst and r.mapped_outputs
+
+
+# ---------------------------------------------------------------------------
+# compile() integration on the 8-device mesh
+# ---------------------------------------------------------------------------
+def test_auto_parallel_trains_mnist_mlp():
+    from flexflow_tpu.training.optimizer import SGDOptimizer
+
+    model = mlp_model(batch=32, hidden=128, tp=2, dp=2, auto=True)
+    model.compile(optimizer=SGDOptimizer(model, lr=0.05),
+                  loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[MetricsType.METRICS_ACCURACY])
+    assert model.strategy is not None
+    assert len(model.strategy.ops) == len(model.layers)
+    rng = np.random.RandomState(0)
+    x = rng.randn(128, 64).astype(np.float32)
+    w = rng.randn(64, 8).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)[:, None]
+    first = model.train_one_batch([x[:32]], y[:32])
+    for _ in range(20):
+        for i in range(0, 128, 32):
+            loss = model.train_one_batch([x[i:i + 32]], y[i:i + 32])
+    assert loss < first  # learns under searched shardings
+
+
+def test_auto_parallel_weight_shardings_applied():
+    import jax
+
+    model = mlp_model(batch=32, hidden=256, tp=4, dp=2, auto=True)
+    model.compile()
+    # at least one weight must be sharded over >1 devices if the search
+    # chose a tp form for any linear
+    sharded = []
+    for lname, ws in model.params.items():
+        for wname, arr in ws.items():
+            ns = arr.sharding
+            if not ns.is_fully_replicated:
+                sharded.append((lname, wname))
+    strat_names = {s.name for s in model.strategy.ops.values()}
+    if any("tp" in n for n in strat_names):
+        assert sharded
