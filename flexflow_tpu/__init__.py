@@ -45,6 +45,11 @@ from flexflow_tpu.core.initializer import (
 )
 from flexflow_tpu.training.optimizer import AdamOptimizer, SGDOptimizer
 from flexflow_tpu.training.dataloader import SingleDataLoader
+from flexflow_tpu.training.checkpoint import (
+    CheckpointManager,
+    load_weights_npz,
+    save_weights_npz,
+)
 
 __version__ = "0.1.0"
 
@@ -52,6 +57,7 @@ __all__ = [
     "ActiMode",
     "AdamOptimizer",
     "AggrMode",
+    "CheckpointManager",
     "CompMode",
     "ConstantInitializer",
     "DataType",

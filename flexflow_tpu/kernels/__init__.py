@@ -1,0 +1,35 @@
+"""Pallas TPU kernels for the serving hot path.
+
+The reference implements its serving hot ops as hand-written CUDA
+(reference src/ops/inc_multihead_self_attention.cu,
+spec_inc_multihead_self_attention.cu, tree_inc_multihead_self_attention.cu —
+~2.8K LoC — plus sampling/top-k kernels under src/ops/kernels/). The TPU
+equivalents live here as Pallas kernels; every kernel has a pure-jnp
+reference path used on CPU (tests) and as a numerics oracle.
+
+Dispatch: ``use_pallas(config)`` returns True on a real TPU backend (or when
+FF_PALLAS_INTERPRET=1 forces interpreter-mode kernels on CPU, which the
+kernel unit tests use to exercise the Pallas code path everywhere).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pallas_interpret_forced() -> bool:
+    return os.environ.get("FF_PALLAS_INTERPRET", "") not in ("", "0")
+
+
+def use_pallas(config=None) -> bool:
+    """Should serving ops run their Pallas kernels?"""
+    if config is not None and not getattr(config, "use_pallas", True):
+        return False
+    if pallas_interpret_forced():
+        return True
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+from flexflow_tpu.kernels.attention import flash_attend  # noqa: E402,F401

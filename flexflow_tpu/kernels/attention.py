@@ -1,0 +1,263 @@
+"""Fused KV-cache attention as a Pallas TPU kernel.
+
+One kernel serves all three reference serving-attention variants
+(reference src/ops/inc_multihead_self_attention.cu:560
+compute_attention_kernel, spec_inc_multihead_self_attention.cu,
+tree_inc_multihead_self_attention.cu):
+
+* incremental decode  — ``causal=True``, Q = 1 token per request
+* prompt prefill      — ``causal=True``, Q = padded prompt length
+* tree verification   — ``causal=False`` with an explicit additive ``bias``
+                        [R, Q, S] carrying the prefix+ancestor tree mask
+* ALiBi position bias — optional in-kernel ``-slope * (qpos - s)`` term
+
+Design (TPU-first, not a CUDA translation):
+- grid is one program per request slot; the KV cache stays in HBM and is
+  streamed through VMEM in double-buffered ``BLOCK_S`` chunks (async DMA
+  overlaps the MXU work on the previous chunk).
+- online softmax (flash attention) in fp32 scratch, so the [Q, S] score
+  matrix is never materialized in HBM.
+- the per-request loop bound is ``ceil(length[r] / BLOCK_S)`` with lengths
+  scalar-prefetched: finished / inactive request slots cost zero DMA and
+  zero FLOPs (the jnp fallback, like the reference CUDA, pays for max_seq).
+- GQA/MQA: queries are pre-packed to [KH, G*Q, D] so the kernel's inner
+  matmuls are KH-batched [G*Q, D] x [D, BLOCK_S] MXU calls.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # finite "minus infinity": keeps online softmax NaN-free
+
+
+def _pick_block_s(S: int) -> int:
+    for bs in (512, 256, 128):
+        if S % bs == 0:
+            return bs
+    return 0  # caller falls back to the jnp path
+
+
+def _kernel(len_ref,                       # scalar prefetch: [R] int32
+            q_ref, qp_ref, slopes_ref, bias_hbm, k_hbm, v_hbm,
+            o_ref,
+            acc, m, l, kbuf, vbuf, bbuf, sem,
+            *, BS: int, causal: bool, has_bias: bool, has_alibi: bool,
+            qk_scale: float, G: int, Q: int):
+    r = pl.program_id(0)
+    length = len_ref[r]
+    nb = (length + jnp.asarray(BS - 1, length.dtype)) // BS
+
+    acc[:] = jnp.zeros_like(acc)
+    m[:] = jnp.full_like(m, NEG_INF)
+    l[:] = jnp.zeros_like(l)
+
+    def dmas(slot, i):
+        yield pltpu.make_async_copy(
+            k_hbm.at[r, :, pl.ds(i * BS, BS)], kbuf.at[slot],
+            sem.at[slot, 0])
+        yield pltpu.make_async_copy(
+            v_hbm.at[r, :, pl.ds(i * BS, BS)], vbuf.at[slot],
+            sem.at[slot, 1])
+        if has_bias:
+            yield pltpu.make_async_copy(
+                bias_hbm.at[r, :, pl.ds(i * BS, BS)], bbuf.at[slot],
+                sem.at[slot, 2])
+
+    def start_dmas(slot, i):
+        for d in dmas(slot, i):
+            d.start()
+
+    def wait_dmas(slot, i):
+        for d in dmas(slot, i):
+            d.wait()
+
+    @pl.when(nb > 0)
+    def _():
+        start_dmas(0, 0)
+
+    qt = q_ref[0]                                   # [KH, GQ, D]
+    GQ = qt.shape[1]
+    qp = qp_ref[r]                                  # [GQ] absolute positions
+
+    def body(i, _):
+        slot = i % 2
+
+        @pl.when(i + 1 < nb)
+        def _():
+            start_dmas((i + 1) % 2, i + 1)
+
+        wait_dmas(slot, i)
+        k = kbuf[slot]                              # [KH, BS, D]
+        v = vbuf[slot]
+        # scores[kh, gq, s] = q[kh, gq, :] . k[kh, s, :]
+        s = jax.lax.dot_general(
+            qt.astype(k.dtype), k,
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)     # [KH, GQ, BS]
+        s = s * qk_scale
+        s_ids = i * BS + jax.lax.broadcasted_iota(jnp.int32, (GQ, BS), 1)
+        if has_alibi:
+            dist = (qp[:, None] - s_ids).astype(jnp.float32)
+            s = s - slopes_ref[:, :][:, :, None] * dist[None]
+        if has_bias:
+            b = bbuf[slot]                          # [Q, BS]
+            s = s + jnp.tile(b, (G, 1))[None]       # row g*Q+q <- b[q]
+        if causal:
+            visible = s_ids <= qp[:, None]
+        else:
+            visible = jnp.ones((GQ, BS), dtype=bool)
+        visible = visible & (s_ids < length)
+        s = jnp.where(visible[None], s, NEG_INF)
+
+        m_new = jnp.maximum(m[:], jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m[:] - m_new)
+        p = jnp.exp(s - m_new)                      # [KH, GQ, BS] f32
+        l[:] = l[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)     # [KH, GQ, D]
+        acc[:] = acc[:] * corr + pv
+        m[:] = m_new
+        return 0
+
+    jax.lax.fori_loop(0, nb, body, 0)
+    o_ref[:] = (acc[:] / jnp.maximum(l[:], 1e-30))[None].astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "qk_scale", "interpret", "out_dtype"))
+def flash_attend(q, k_cache, v_cache, lengths, qpos, bias=None,
+                 alibi=None, *, causal=True, qk_scale=None,
+                 out_dtype=None, interpret=False):
+    """Batched KV-cache attention.
+
+    q        [R, Q, H, D]   new-token queries (rotary already applied)
+    k/v      [R, KH, S, D]  full cache (new tokens already appended)
+    lengths  [R] int32      valid cache extent per request (0 => skip slot)
+    qpos     [R, Q] int32   absolute position of each query token
+    bias     [R, Q, S] f32  optional additive mask (tree mask; NEG_INF=hidden)
+    alibi    [H] f32        optional ALiBi slopes
+    returns  [R, Q, H*D]
+    """
+    R, Q, H, D = q.shape
+    KH, S = k_cache.shape[1], k_cache.shape[2]
+    G = H // KH
+    GQ = G * Q
+    BS = _pick_block_s(S)
+    assert BS > 0, f"S={S} not divisible by a supported block size"
+    if qk_scale is None:
+        qk_scale = 1.0 / math.sqrt(D)
+    out_dtype = out_dtype or q.dtype
+
+    # [R, Q, H, D] -> [R, KH, G*Q, D], row index g*Q + q
+    qt = q.reshape(R, Q, KH, G, D).transpose(0, 2, 3, 1, 4).reshape(
+        R, KH, GQ, D)
+    qp_gq = jnp.tile(qpos.astype(jnp.int32), (1, G))            # [R, GQ]
+    has_bias = bias is not None
+    has_alibi = alibi is not None
+    if has_alibi:
+        slopes_gq = jnp.repeat(
+            alibi.astype(jnp.float32).reshape(KH, G), Q, axis=1)  # [KH, GQ]
+    else:
+        slopes_gq = jnp.zeros((KH, GQ), jnp.float32)
+    if not has_bias:
+        bias = jnp.zeros((R, 1, S), jnp.float32)  # placeholder, never DMA'd
+
+    # Clamp: an out-of-range length would DMA past the cache end.
+    lengths = jnp.minimum(lengths.astype(jnp.int32), S)
+
+    kern = functools.partial(
+        _kernel, BS=BS, causal=causal, has_bias=has_bias,
+        has_alibi=has_alibi, qk_scale=float(qk_scale), G=G, Q=Q)
+
+    cache_dt = k_cache.dtype
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(R,),
+        in_specs=[
+            pl.BlockSpec((1, KH, GQ, D), lambda r, *_: (r, 0, 0, 0),
+                         memory_space=pltpu.VMEM),               # qt
+            pl.BlockSpec(memory_space=pltpu.VMEM),               # qp [R, GQ]
+            pl.BlockSpec((KH, GQ), lambda r, *_: (0, 0),
+                         memory_space=pltpu.VMEM),               # slopes
+            pl.BlockSpec(memory_space=pl.ANY),                   # bias (HBM)
+            pl.BlockSpec(memory_space=pl.ANY),                   # k cache
+            pl.BlockSpec(memory_space=pl.ANY),                   # v cache
+        ],
+        out_specs=pl.BlockSpec((1, KH, GQ, D), lambda r, *_: (r, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((KH, GQ, D), jnp.float32),                # acc
+            pltpu.VMEM((KH, GQ, 1), jnp.float32),                # m
+            pltpu.VMEM((KH, GQ, 1), jnp.float32),                # l
+            pltpu.VMEM((2, KH, BS, D), cache_dt),                # k buf
+            pltpu.VMEM((2, KH, BS, D), cache_dt),                # v buf
+            pltpu.VMEM((2, Q, BS), jnp.float32),                 # bias buf
+            pltpu.SemaphoreType.DMA((2, 3)),
+        ],
+    )
+    kv_bytes = 2 * 2 * BS * KH * D * cache_dt.itemsize
+    out = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((R, KH, GQ, D), out_dtype),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=int(min(
+                128 * 1024 * 1024,
+                8 * (KH * GQ * (D + 2) * 4 + KH * GQ * D * 2
+                     + kv_bytes + 2 * Q * BS * 4) + 1024 * 1024)),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * R * GQ * KH * D * S,
+            bytes_accessed=2 * R * S * KH * D * cache_dt.itemsize,
+            transcendentals=R * KH * GQ * S,
+        ),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), qt, qp_gq, slopes_gq,
+      bias.astype(jnp.float32), k_cache, v_cache)
+
+
+    # [R, KH, G*Q, D] -> [R, Q, H*D] with h = kh*G + g
+    return out.reshape(R, KH, G, Q, D).transpose(0, 3, 1, 2, 4).reshape(
+        R, Q, H * D)
+
+
+def reference_attend(q, k_cache, v_cache, lengths, qpos, bias=None,
+                     alibi=None, *, causal=True, qk_scale=None,
+                     out_dtype=None):
+    """Pure-jnp oracle with identical semantics (used on CPU and in tests)."""
+    R, Q, H, D = q.shape
+    KH, S = k_cache.shape[1], k_cache.shape[2]
+    G = H // KH
+    if qk_scale is None:
+        qk_scale = 1.0 / math.sqrt(D)
+    out_dtype = out_dtype or q.dtype
+    qg = q.reshape(R, Q, KH, G, D)
+    kc = k_cache.astype(q.dtype)
+    vc = v_cache.astype(q.dtype)
+    s = jnp.einsum("rqkgd,rksd->rkgqs", qg, kc,
+                   preferred_element_type=jnp.float32) * qk_scale
+    s_ids = jnp.arange(S)[None, None, :]                       # [1,1,S]
+    if alibi is not None:
+        dist = (qpos[:, :, None] - s_ids).astype(jnp.float32)  # [R,Q,S]
+        slopes = alibi.astype(jnp.float32).reshape(KH, G)
+        s = s - slopes[None, :, :, None, None] * dist[:, None, None, :, :]
+    if bias is not None:
+        b = bias.astype(jnp.float32)                           # [R,Q,S]
+        s = s + b[:, None, None, :, :]
+    visible = jnp.ones((R, Q, S), bool) if not causal else \
+        (s_ids <= qpos[:, :, None])
+    visible = visible & (s_ids < lengths[:, None, None])
+    s = jnp.where(visible[:, None, None, :, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("rkgqs,rksd->rqkgd", p.astype(q.dtype), vc)
+    return out.reshape(R, Q, H * D).astype(out_dtype)

@@ -62,17 +62,19 @@ def apply_rotary(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray
 # ----------------------------------------------------------------------
 def append_kv(cache: jnp.ndarray, new: jnp.ndarray, start_pos: jnp.ndarray,
               num_tokens: jnp.ndarray, active: jnp.ndarray) -> jnp.ndarray:
-    """Scatter new [R, Q, KH, D] into cache [R, S, KH, D] at per-slot offsets.
+    """Scatter new [R, Q, KH, D] into cache [R, KH, S, D] at per-slot offsets.
 
     Padding tokens and inactive slots are routed out of bounds and dropped.
+    The head-major cache layout keeps each head's [S, D] block contiguous,
+    which is what the Pallas decode kernel streams per KH-batched matmul.
     """
     R, Q = new.shape[0], new.shape[1]
-    S = cache.shape[1]
+    S = cache.shape[2]
     rows = jnp.arange(R)[:, None]                                   # [R, 1]
     cols = start_pos[:, None] + jnp.arange(Q)[None, :]              # [R, Q]
     valid = (jnp.arange(Q)[None, :] < num_tokens[:, None]) & active[:, None]
     cols = jnp.where(valid, cols, S)  # out of bounds -> dropped
-    return cache.at[rows, cols].set(new.astype(cache.dtype), mode="drop")
+    return cache.at[rows, :, cols].set(new.astype(cache.dtype), mode="drop")
 
 
 def _qkv(attrs, params, x, compute_dtype):
@@ -102,37 +104,35 @@ def alibi_slopes(num_heads: int) -> jnp.ndarray:
     return slopes
 
 
-def _attend(attrs, q, k_cache, v_cache, key_mask, out_dtype, qpos=None):
-    """q [R,Q,H,D] x cache [R,S,KH,D] -> [R, Q, H*D].
+def _attend(attrs, q, k_cache, v_cache, lengths, qpos, out_dtype, ctx,
+            bias=None, causal=True):
+    """q [R,Q,H,D] x cache [R,KH,S,D] -> [R, Q, H*D].
 
-    key_mask [R, Q, S] says which cache positions each query may see;
-    qpos [R, Q] absolute query positions (for ALiBi position bias).
+    Dispatches to the Pallas flash kernel on TPU (kernels/attention.py) or
+    the jnp oracle elsewhere. ``lengths`` [R] is the valid cache extent
+    (finished/inactive slots pass 0 and cost nothing on the Pallas path);
+    ``qpos`` [R, Q] absolute query positions drive causal masking + ALiBi;
+    ``bias`` [R, Q, S] is the additive tree mask for verification.
     """
-    H = attrs["num_q_heads"]
-    KH = attrs["num_kv_heads"]
+    from flexflow_tpu import kernels as ffk
+    from flexflow_tpu.kernels.attention import flash_attend, reference_attend
+
     D = attrs["head_dim"]
-    G = H // KH
-    R, Q = q.shape[0], q.shape[1]
-    S = k_cache.shape[1]
-    qg = q.reshape(R, Q, KH, G, D)
-    kc = k_cache.astype(q.dtype)
-    vc = v_cache.astype(q.dtype)
-    scores = jnp.einsum("rqkgd,rskd->rkgqs", qg, kc,
-                        preferred_element_type=jnp.float32)
-    if attrs.get("qk_prod_scaling", True):
-        scores = scores / math.sqrt(D)
+    scale = (1.0 / math.sqrt(D)) if attrs.get("qk_prod_scaling", True) else 1.0
     if attrs.get("scaling_query", False):
-        scores = scores * attrs.get("scaling_factor", 1.0)
-    if attrs.get("position_bias", False):
-        dist = (qpos[:, :, None] - jnp.arange(S)[None, None, :]
-                ).astype(jnp.float32)                            # [R,Q,S]
-        bias = -alibi_slopes(H).reshape(KH, G)[None, :, :, None, None] \
-            * dist[:, None, None, :, :]
-        scores = scores + bias
-    scores = jnp.where(key_mask[:, None, None, :, :], scores, -jnp.inf)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    out = jnp.einsum("rkgqs,rskd->rqkgd", probs, vc)
-    return out.reshape(R, Q, H * D).astype(out_dtype)
+        scale = scale * attrs.get("scaling_factor", 1.0)
+    alibi = (alibi_slopes(attrs["num_q_heads"])
+             if attrs.get("position_bias", False) else None)
+    S = k_cache.shape[2]
+    cfg = ctx.config if ctx is not None else None
+    if ffk.use_pallas(cfg) and S % 128 == 0 and q.shape[1] <= 256:
+        return flash_attend(
+            q, k_cache, v_cache, lengths, qpos, bias=bias, alibi=alibi,
+            causal=causal, qk_scale=scale, out_dtype=out_dtype,
+            interpret=ffk.pallas_interpret_forced())
+    return reference_attend(
+        q, k_cache, v_cache, lengths, qpos, bias=bias, alibi=alibi,
+        causal=causal, qk_scale=scale, out_dtype=out_dtype)
 
 
 def _weight_specs(attrs, input_specs):
@@ -166,8 +166,8 @@ def _init_kv_state(attrs, input_specs):
     KH, D = attrs["num_kv_heads"], attrs["head_dim"]
     cache_dtype = jnp.dtype(attrs.get("cache_dtype", "bfloat16"))
     return {
-        "k_cache": jnp.zeros((R, S, KH, D), dtype=cache_dtype),
-        "v_cache": jnp.zeros((R, S, KH, D), dtype=cache_dtype),
+        "k_cache": jnp.zeros((R, KH, S, D), dtype=cache_dtype),
+        "v_cache": jnp.zeros((R, KH, S, D), dtype=cache_dtype),
     }
 
 
@@ -246,15 +246,13 @@ class IncMultiHeadSelfAttention(OpImpl):
         v_cache = append_kv(v_cache0, v, meta.start_pos,
                             meta.num_tokens, meta.active)
         write_kv(ctx, attrs, k_cache, v_cache)
-        # Causal mask over absolute cache positions: query token i (at
-        # position start+i) sees cache[s] for s <= start+i.
-        S = k_cache.shape[1]
+        # Causal over absolute cache positions: query token i (at position
+        # start+i) sees cache[s] for s <= start+i (enforced in the kernel).
         Q = x.shape[1]
-        key_pos = jnp.arange(S)[None, None, :]                     # [1,1,S]
         q_abs = meta.start_pos[:, None] + jnp.arange(Q)[None, :]   # [R,Q]
-        key_mask = key_pos <= q_abs[:, :, None]                    # [R,Q,S]
-        out = _attend(attrs, q, k_cache, v_cache, key_mask, x.dtype,
-                      qpos=q_abs)
+        lengths = jnp.where(meta.active, meta.start_pos + meta.num_tokens, 0)
+        out = _attend(attrs, q, k_cache, v_cache, lengths, q_abs, x.dtype,
+                      ctx, causal=True)
         return [_project_out(attrs, params, ctx, out)]
 
 
@@ -302,8 +300,9 @@ class TreeIncMultiHeadSelfAttention(OpImpl):
         v_cache = append_kv(v_cache0, v, meta.start_pos,
                             meta.num_nodes, meta.active)
         write_kv(ctx, attrs, k_cache, v_cache)
-        # Mask: committed prefix OR ancestor-or-self within the tree region.
-        S = k_cache.shape[1]
+        # Tree mask as additive bias: committed prefix (s < start) is open by
+        # default; within the tree region only ancestor-or-self is open.
+        S = k_cache.shape[2]
         T = x.shape[1]
         key_pos = jnp.arange(S)[None, None, :]
         committed = key_pos < meta.start_pos[:, None, None]        # [R,1,S]
@@ -315,8 +314,11 @@ class TreeIncMultiHeadSelfAttention(OpImpl):
         anc = jnp.take_along_axis(
             meta.ancestor, node_idx[:, None, :].repeat(T, axis=1), axis=2)
         key_mask = committed | (in_tree[:, None, :] & anc)
-        out = _attend(attrs, q, k_cache, v_cache, key_mask, x.dtype,
-                      qpos=meta.positions)
+        from flexflow_tpu.kernels.attention import NEG_INF
+        bias = jnp.where(key_mask, 0.0, NEG_INF).astype(jnp.float32)
+        lengths = jnp.where(meta.active, meta.start_pos + meta.num_nodes, 0)
+        out = _attend(attrs, q, k_cache, v_cache, lengths, meta.positions,
+                      x.dtype, ctx, bias=bias, causal=False)
         return [_project_out(attrs, params, ctx, out)]
 
 
@@ -334,17 +336,17 @@ def commit_tree_kv(op_state: Dict[str, Any], src_node: jnp.ndarray,
     driven by TreeVerifyBatchConfig::committed_tokens.
     """
 
-    def commit_one(cache):
+    def commit_one(cache):                          # [R, KH, S, D]
         R = cache.shape[0]
-        S = cache.shape[1]
+        S = cache.shape[2]
         C = src_node.shape[1]
         rows = jnp.arange(R)[:, None]
         valid = (jnp.arange(C)[None, :] < num_commit[:, None]) & active[:, None]
         src = start_pos[:, None] + src_node
         src = jnp.clip(src, 0, S - 1)
-        moved = cache[rows, src]                                   # [R,C,KH,D]
+        moved = cache[rows, :, src]                                # [R,C,KH,D]
         dst = jnp.where(valid, start_pos[:, None] + jnp.arange(C)[None, :], S)
-        return cache.at[rows, dst].set(moved, mode="drop")
+        return cache.at[rows, :, dst].set(moved, mode="drop")
 
     new_state = {}
     for layer_name, st in op_state.items():

@@ -1,0 +1,175 @@
+"""Checkpoint / resume.
+
+The reference has NO native checkpointing (SURVEY §5): training scripts DIY
+via Tensor.get_weights/set_weights numpy round-trips (reference
+python/flexflow/core/flexflow_cffi.py:937-1229) and serving loads raw weight
+files (reference inference/file_loader.cc:757). This module is the required
+upgrade: real save/restore of the full training state — params, optimizer
+state, step counter, RNG, and dataloader position — via orbax (async,
+sharding-aware, multi-host safe), so a training run resumes bit-identically.
+
+Design: FFModel keeps all mutable state in jax pytrees (``params``,
+``opt_state``, ``op_state``), so a checkpoint is just those pytrees plus a
+small metadata dict. Orbax restores arrays with their NamedSharding layouts
+onto the model's mesh automatically (restore_args built from the live model).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def _ocp():
+    import orbax.checkpoint as ocp
+
+    return ocp
+
+
+def _replace_like(restored, template):
+    """Re-place restored leaves to match the live model's placement.
+
+    Orbax restores arrays *committed* to devices. Mesh-sharded leaves keep
+    their NamedSharding; leaves the model created eagerly (e.g. the scalar
+    optimizer step) must come back uncommitted, or jit refuses to mix them
+    with mesh-sharded arguments.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    def fix(r, t):
+        sh = getattr(t, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            return jax.device_put(r, sh)
+        return jnp.asarray(np.asarray(r))
+
+    return jax.tree.map(fix, restored, template)
+
+
+class CheckpointManager:
+    """Save/restore FFModel training state to ``directory/step_N``.
+
+    Mirrors orbax's CheckpointManager semantics (max_to_keep, save_interval)
+    behind a small API shaped for FFModel.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 save_interval_steps: int = 1):
+        ocp = _ocp()
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            enable_async_checkpointing=False,  # deterministic for tests
+        )
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+
+    # ------------------------------------------------------------------
+    def save(self, step: int, model, dataloader_state: Optional[Dict] = None,
+             extra: Optional[Dict[str, Any]] = None, force: bool = False
+             ) -> bool:
+        ocp = _ocp()
+        state = {"params": model.params, "rng": model._rng}
+        if model.opt_state is not None:
+            state["opt_state"] = model.opt_state
+        if model.op_state:
+            # batch-norm running stats, KV caches, dropout bookkeeping
+            state["op_state"] = model.op_state
+        meta = {
+            "step": int(step),
+            "dataloader_state": dataloader_state or {},
+            "extra": extra or {},
+        }
+        saved = self._mgr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(state),
+                meta=ocp.args.JsonSave(meta),
+            ),
+            force=force,
+        )
+        self._mgr.wait_until_finished()
+        return saved
+
+    # ------------------------------------------------------------------
+    def restore(self, model, step: Optional[int] = None) -> Dict[str, Any]:
+        """Restore into ``model`` in place; returns the metadata dict."""
+        ocp = _ocp()
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        template = {"params": model.params, "rng": model._rng}
+        if model.opt_state is not None:
+            template["opt_state"] = model.opt_state
+        if model.op_state:
+            template["op_state"] = model.op_state
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(template),
+                meta=ocp.args.JsonRestore(),
+            ),
+        )
+        state = _replace_like(restored["state"], template)
+        model.params = state["params"]
+        model._rng = state["rng"]
+        if "opt_state" in state:
+            model.opt_state = state["opt_state"]
+        if "op_state" in state:
+            model.op_state = state["op_state"]
+        return dict(restored["meta"])
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return sorted(self._mgr.all_steps())
+
+    def close(self):
+        self._mgr.close()
+
+
+# ----------------------------------------------------------------------
+# Flat weight export/import — the serving-side counterpart of the reference
+# FileDataLoader (inference/file_loader.cc:757): one binary blob per weight
+# with HF-style dotted names, so weights interchange with the model zoo's
+# name mapping (models/__init__.py) without orbax metadata.
+# ----------------------------------------------------------------------
+def save_weights_npz(path: str, model) -> None:
+    flat = {}
+    for lname, lp in model.params.items():
+        for wname, w in lp.items():
+            flat[f"{lname}.{wname}"] = np.asarray(w)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    np.savez(path, **flat)
+
+
+def load_weights_npz(path: str, model) -> None:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    with np.load(path) as data:
+        for lname, lp in model.params.items():
+            for wname in lp:
+                key = f"{lname}.{wname}"
+                if key not in data:
+                    raise KeyError(f"checkpoint missing weight {key}")
+                arr = data[key]
+                old = lp[wname]
+                if tuple(arr.shape) != tuple(old.shape):
+                    raise ValueError(
+                        f"{key}: shape {arr.shape} != {old.shape}")
+                sh = getattr(old, "sharding", None)
+                if isinstance(sh, NamedSharding):
+                    # keep the mesh layout (a TP-sharded 7B must not land
+                    # unsharded on one device)
+                    lp[wname] = jax.device_put(
+                        arr.astype(old.dtype), sh)
+                else:
+                    lp[wname] = jnp.asarray(arr, dtype=old.dtype)

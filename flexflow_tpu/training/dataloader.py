@@ -33,6 +33,13 @@ class SingleDataLoader:
     def reset(self):
         self.idx = 0
 
+    # checkpoint/resume support (training/checkpoint.py)
+    def state_dict(self):
+        return {"idx": self.idx}
+
+    def load_state_dict(self, state):
+        self.idx = int(state.get("idx", 0))
+
     def next_batch(self, ffmodel=None):
         """Returns the next batch as a device array with batch sharding."""
         model = ffmodel or self.ffmodel
