@@ -670,6 +670,10 @@ class FFModel:
             self.label_tensor = Tensor(lshape, ldtype, name="label", model=self)
 
         if optimizer is not None:
+            # Back-reference so optimizer.set_learning_rate can reach the
+            # live (device-side) opt_state even when the optimizer was
+            # constructed without a model.
+            optimizer.ffmodel = self
             self.opt_state = optimizer.init_state(params)
 
         compute_dtype = jnp.dtype(self.config.compute_dtype)

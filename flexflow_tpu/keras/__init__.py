@@ -1,0 +1,25 @@
+"""Keras-compatible frontend for the TPU-native framework.
+
+Capability parity with the reference ``python/flexflow/keras/`` (~6.7K LoC):
+Sequential + functional models whose layers lower onto the FFModel op-builder
+API, then jit-compile to XLA train/eval/predict steps over the device mesh.
+"""
+
+from flexflow_tpu.keras import (
+    callbacks,
+    datasets,
+    initializers,
+    layers,
+    losses,
+    metrics,
+    models,
+    optimizers,
+    preprocessing,
+    utils,
+)
+from flexflow_tpu.keras.layers import Input
+from flexflow_tpu.keras.models import Model, Sequential
+
+__all__ = ["callbacks", "datasets", "initializers", "layers", "losses",
+           "metrics", "models", "optimizers", "preprocessing", "utils",
+           "Input", "Model", "Sequential"]

@@ -44,6 +44,12 @@ def _pick_block_s(S: int) -> int:
     return 0  # caller falls back to the jnp path
 
 
+def supports_seq_len(S: int) -> bool:
+    """Single source of truth for dispatch guards in ops/ — True iff the
+    Pallas kernels here can tile a cache of length S."""
+    return _pick_block_s(S) > 0
+
+
 def _kernel(len_ref,                       # scalar prefetch: [R] int32
             q_ref, qp_ref, slopes_ref, bias_hbm, k_hbm, v_hbm,
             o_ref,
@@ -170,7 +176,9 @@ def flash_attend(q, k_cache, v_cache, lengths, qpos, bias=None,
     else:
         slopes_gq = jnp.zeros((KH, GQ), jnp.float32)
     if not has_bias:
-        bias = jnp.zeros((R, 1, S), jnp.float32)  # placeholder, never DMA'd
+        # Minimal placeholder to fill the operand slot; the kernel only
+        # DMAs bias when has_bias=True, so no [R, 1, S] HBM buffer needed.
+        bias = jnp.zeros((1, 1, 1), jnp.float32)
 
     # Clamp: an out-of-range length would DMA past the cache end.
     lengths = jnp.minimum(lengths.astype(jnp.int32), S)

@@ -125,7 +125,8 @@ def _attend(attrs, q, k_cache, v_cache, lengths, qpos, out_dtype, ctx,
              if attrs.get("position_bias", False) else None)
     S = k_cache.shape[2]
     cfg = ctx.config if ctx is not None else None
-    if ffk.use_pallas(cfg) and S % 128 == 0 and q.shape[1] <= 256:
+    from flexflow_tpu.kernels.attention import supports_seq_len
+    if ffk.use_pallas(cfg) and supports_seq_len(S) and q.shape[1] <= 256:
         return flash_attend(
             q, k_cache, v_cache, lengths, qpos, bias=bias, alibi=alibi,
             causal=causal, qk_scale=scale, out_dtype=out_dtype,
@@ -350,7 +351,7 @@ def commit_tree_kv(op_state: Dict[str, Any], src_node: jnp.ndarray,
 
     new_state = {}
     for layer_name, st in op_state.items():
-        if layer_name == "kv_cache":  # stacked [L, R, S, KH, D] layout
+        if layer_name == "kv_cache":  # stacked [L, R, KH, S, D] layout
             new_state[layer_name] = {
                 "k": jax.vmap(commit_one)(st["k"]),
                 "v": jax.vmap(commit_one)(st["v"]),

@@ -31,9 +31,16 @@ class Optimizer:
         """Returns (new_params, new_state). Pure; called under jit."""
         raise NotImplementedError
 
-    # reference API parity (flexflow_cffi.py SGDOptimizer.set_lr etc.)
+    # reference API parity (flexflow_cffi.py SGDOptimizer.set_lr etc.).
+    # The live rate is part of the (device-side) optimizer state so that a
+    # scheduler can change it between steps without re-tracing the jitted
+    # train step.
     def set_learning_rate(self, lr: float):
         self.lr = lr
+        m = self.ffmodel
+        if m is not None and getattr(m, "opt_state", None) is not None \
+                and "lr" in m.opt_state:
+            m.opt_state = dict(m.opt_state, lr=jnp.asarray(lr, jnp.float32))
 
 
 class SGDOptimizer(Optimizer):
@@ -49,28 +56,28 @@ class SGDOptimizer(Optimizer):
         self.weight_decay = weight_decay
 
     def init_state(self, params):
-        if self.momentum == 0.0:
-            return {"step": jnp.zeros((), jnp.int32)}
-        return {
-            "step": jnp.zeros((), jnp.int32),
-            "velocity": jax.tree.map(jnp.zeros_like, params),
-        }
+        state = {"step": jnp.zeros((), jnp.int32),
+                 "lr": jnp.asarray(self.lr, jnp.float32)}
+        if self.momentum != 0.0:
+            state["velocity"] = jax.tree.map(jnp.zeros_like, params)
+        return state
 
     def update_step(self, params, grads, state):
-        lr, mu, wd = self.lr, self.momentum, self.weight_decay
+        lr, mu, wd = state["lr"], self.momentum, self.weight_decay
 
         if wd > 0.0:
             grads = jax.tree.map(lambda g, p: g + wd * p, grads, params)
         if mu == 0.0:
             new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
-            return new_params, {"step": state["step"] + 1}
+            return new_params, {"step": state["step"] + 1, "lr": lr}
         new_vel = jax.tree.map(lambda v, g: mu * v + g, state["velocity"], grads)
         if self.nesterov:
             upd = jax.tree.map(lambda g, v: g + mu * v, grads, new_vel)
         else:
             upd = new_vel
         new_params = jax.tree.map(lambda p, u: p - lr * u, params, upd)
-        return new_params, {"step": state["step"] + 1, "velocity": new_vel}
+        return new_params, {"step": state["step"] + 1, "lr": lr,
+                            "velocity": new_vel}
 
 
 class AdamOptimizer(Optimizer):
@@ -90,6 +97,7 @@ class AdamOptimizer(Optimizer):
     def init_state(self, params):
         return {
             "step": jnp.zeros((), jnp.int32),
+            "lr": jnp.asarray(self.lr, jnp.float32),
             "m": jax.tree.map(jnp.zeros_like, params),
             "v": jax.tree.map(jnp.zeros_like, params),
         }
@@ -103,8 +111,9 @@ class AdamOptimizer(Optimizer):
         new_v = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * jnp.square(g),
                              state["v"], grads)
         t = step.astype(jnp.float32)
-        alpha_t = self.lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        alpha_t = state["lr"] * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
         new_params = jax.tree.map(
             lambda p, m, v: p - alpha_t * m / (jnp.sqrt(v) + eps),
             params, new_m, new_v)
-        return new_params, {"step": step, "m": new_m, "v": new_v}
+        return new_params, {"step": step, "lr": state["lr"],
+                            "m": new_m, "v": new_v}
