@@ -786,8 +786,13 @@ class FFModel:
         return float(loss)
 
     def fit(self, x=None, y=None, batch_size: Optional[int] = None,
-            epochs: Optional[int] = None, shuffle: bool = False):
-        """Keras-style fit (reference flexflow_cffi.py:3534)."""
+            epochs: Optional[int] = None, shuffle: bool = False,
+            initial_epoch: int = 0):
+        """Keras-style fit (reference flexflow_cffi.py:3534).
+
+        ``initial_epoch`` offsets the shuffle seed so outer epoch loops
+        (e.g. the Keras frontend calling fit(epochs=1) per epoch for
+        callbacks) still get a fresh permutation each epoch."""
         xs = x if isinstance(x, (list, tuple)) else [x]
         xs = [np.asarray(a) for a in xs]
         y = np.asarray(y)
@@ -802,7 +807,8 @@ class FFModel:
             self.reset_metrics()
             losses = []
             for batch in minibatches(list(xs) + [y], bs, shuffle=shuffle,
-                                     seed=self.config.seed + epoch):
+                                     seed=self.config.seed + initial_epoch
+                                     + epoch):
                 *bxs, by = batch
                 losses.append(self.train_one_batch(bxs, by))
             history.append({"epoch": epoch, "loss": float(np.mean(losses)),

@@ -72,6 +72,10 @@ class cifar100:
     @staticmethod
     def load_data(label_mode: str = "fine", n_train: int = 5000,
                   n_test: int = 1000) -> Arrays:
+        cached = _cache_path("cifar-100.npz")
+        if cached:
+            with np.load(cached, allow_pickle=True) as f:
+                return (f["x_train"], f["y_train"]), (f["x_test"], f["y_test"])
         num = 100 if label_mode == "fine" else 20
         (xtr, ytr), (xte, yte) = _synthetic_images(
             (3, 32, 32), num, n_train, n_test, seed=2222)

@@ -84,6 +84,11 @@ class Layer:
 
     # --- graph recording -------------------------------------------------
     def __call__(self, inputs):
+        if self.inbound:
+            raise NotImplementedError(
+                f"{self.name}: layer called twice — shared layers (weight "
+                f"tying across call sites) are not supported yet; create a "
+                f"second layer instance instead")
         ins = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
         for t in ins:
             if not isinstance(t, KerasTensor):
@@ -172,8 +177,10 @@ class Dense(Layer):
 
     def build_ff(self, ffmodel, ff_inputs):
         act = self.activation
-        fused = _ACTIVATIONS.get(act if isinstance(act, str) or act is None
-                                 else None, None)
+        if act is not None and not isinstance(act, str):
+            raise ValueError(f"{self.name}: activation must be a string or "
+                             f"None, got {act!r}")
+        fused = _ACTIVATIONS.get(act)
         from flexflow_tpu.keras.initializers import as_core_initializer
         x = ffmodel.dense(
             ff_inputs[0], self.units,
@@ -338,8 +345,10 @@ class Conv2D(Layer):
         sh, sw = self.strides
         ph, pw = _conv_padding(self.padding, kh, kw)
         act = self.activation
-        fused = _ACTIVATIONS.get(act if isinstance(act, str) or act is None
-                                 else None, None)
+        if act is not None and not isinstance(act, str):
+            raise ValueError(f"{self.name}: activation must be a string or "
+                             f"None, got {act!r}")
+        fused = _ACTIVATIONS.get(act)
         from flexflow_tpu.keras.initializers import as_core_initializer
         x = ffmodel.conv2d(
             ff_inputs[0], self.filters, kh, kw, sh, sw, ph, pw,

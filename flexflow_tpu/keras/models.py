@@ -162,10 +162,22 @@ class BaseModel:
         if batch_size is not None:
             self._ffconfig.batch_size = batch_size
         self._optimizer = _opt.as_keras_optimizer(optimizer)
-        self._loss = loss if isinstance(loss, LossType) else _LOSSES[loss]
+        if isinstance(loss, LossType):
+            self._loss = loss
+        elif hasattr(loss, "loss_type"):       # keras.losses.* instance
+            self._loss = loss.loss_type
+        else:
+            self._loss = _LOSSES[loss]
         self._metrics = metrics or []
-        metric_types = [m if isinstance(m, MetricsType) else _METRICS[m]
-                        for m in self._metrics]
+
+        def metric_type(m):
+            if isinstance(m, MetricsType):
+                return m
+            if hasattr(m, "metrics_type"):     # keras.metrics.* instance
+                return m.metrics_type
+            return _METRICS[m]
+
+        metric_types = [metric_type(m) for m in self._metrics]
 
         self._ffmodel = self._build_ff(self._ffconfig.batch_size)
         core_opt = self._optimizer.to_core(self._ffmodel)
@@ -192,7 +204,7 @@ class BaseModel:
             for cb in callbacks:
                 cb.on_epoch_begin(epoch)
             rec = self._ffmodel.fit(x, y, batch_size=batch_size, epochs=1,
-                                    shuffle=shuffle)[0]
+                                    shuffle=shuffle, initial_epoch=epoch)[0]
             rec = {k: v for k, v in rec.items() if k != "epoch"}
             history.append(rec)
             for cb in callbacks:

@@ -1,0 +1,6 @@
+"""ONNX frontend — reference python/flexflow/onnx/."""
+
+from flexflow_tpu.onnx.model import ONNXModel
+from flexflow_tpu.onnx.proto import OnnxGraph, load_model
+
+__all__ = ["ONNXModel", "OnnxGraph", "load_model"]

@@ -1,0 +1,5 @@
+"""PyTorch (torch.fx) frontend — reference python/flexflow/torch/."""
+
+from flexflow_tpu.torch.model import PyTorchModel, file_to_ff, ir_to_ff
+
+__all__ = ["PyTorchModel", "file_to_ff", "ir_to_ff"]

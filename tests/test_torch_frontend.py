@@ -1,0 +1,123 @@
+"""torch.fx frontend alignment tests (reference tests/align/ methodology:
+same graph in FF and torch, assert outputs allclose)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+import flexflow_tpu as ff  # noqa: E402
+from flexflow_tpu.torch import PyTorchModel, file_to_ff  # noqa: E402
+
+
+def _compile_inference(ffmodel):
+    ffmodel.compile()
+
+
+class MLP(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(20, 32)
+        self.act = nn.ReLU()
+        self.fc2 = nn.Linear(32, 8)
+        self.sm = nn.Softmax(dim=-1)
+
+    def forward(self, x):
+        return self.sm(self.fc2(self.act(self.fc1(x))))
+
+
+class CNN(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(1, 4, 3)
+        self.pool = nn.MaxPool2d(2)
+        self.flatten = nn.Flatten()
+        self.fc = nn.Linear(4 * 13 * 13, 6)
+
+    def forward(self, x):
+        x = torch.relu(self.conv1(x))
+        x = self.pool(x)
+        x = self.flatten(x)
+        return self.fc(x)
+
+
+class ResidualBlock(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 16)
+        self.ln = nn.LayerNorm(16)
+
+    def forward(self, x):
+        h = self.fc1(x)
+        h = h + x            # residual via operator.add
+        h = self.ln(h)
+        h = h * 2.0          # scalar multiply
+        return h.relu()
+
+
+def _align(module, x, batch):
+    pt = PyTorchModel(module)
+    model = ff.FFModel(ff.FFConfig(batch_size=batch))
+    t = model.create_tensor(list(x.shape), ff.DataType.DT_FLOAT)
+    outs = pt.torch_to_ff(model, [t])
+    assert len(outs) == 1
+    _compile_inference(model)
+    pt.copy_weights(model)
+    got = model.predict(x)
+    with torch.no_grad():
+        want = module(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_mlp_alignment():
+    x = np.random.RandomState(0).randn(16, 20).astype(np.float32)
+    _align(MLP(), x, 16)
+
+
+def test_cnn_alignment():
+    x = np.random.RandomState(1).randn(8, 1, 28, 28).astype(np.float32)
+    _align(CNN(), x, 8)
+
+
+def test_residual_scalar_layernorm_alignment():
+    x = np.random.RandomState(2).randn(8, 16).astype(np.float32)
+    _align(ResidualBlock(), x, 8)
+
+
+def test_file_ir_roundtrip(tmp_path):
+    module = MLP()
+    pt = PyTorchModel(module)
+    path = tmp_path / "mlp.ir"
+    pt.torch_to_file(str(path))
+    assert path.exists() and len(path.read_text().splitlines()) >= 6
+
+    x = np.random.RandomState(3).randn(16, 20).astype(np.float32)
+    model = ff.FFModel(ff.FFConfig(batch_size=16))
+    t = model.create_tensor([16, 20], ff.DataType.DT_FLOAT)
+    outs = file_to_ff(str(path), model, [t])
+    assert len(outs) == 1
+    _compile_inference(model)
+    pt.copy_weights(model)
+    got = model.predict(x)
+    with torch.no_grad():
+        want = module(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_trained_torch_translation_trains_in_ff():
+    """Translate an untrained torch MLP then train it in FF."""
+    rng = np.random.RandomState(0)
+    w = rng.randn(20, 4)
+    x = rng.randn(256, 20).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).reshape(-1, 1).astype(np.int32)
+
+    pt = PyTorchModel(MLP())
+    model = ff.FFModel(ff.FFConfig(batch_size=32))
+    t = model.create_tensor([32, 20], ff.DataType.DT_FLOAT)
+    pt.torch_to_ff(model, [t])
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.1),
+                  loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[ff.MetricsType.METRICS_ACCURACY])
+    hist = model.fit(x, y, epochs=6)
+    assert hist[-1]["loss"] < hist[0]["loss"]
