@@ -84,6 +84,9 @@ class FFConfig:
     quantization_type: Optional[str] = None   # None | "int8" | "int4"
     benchmarking: bool = False
     inference_debugging: bool = False
+    # host-side batch bookkeeping in native C++ (native/src/
+    # batch_scheduler.cpp) when the library builds; falls back to Python
+    use_native_scheduler: bool = True
 
     # --- profiling / logging (reference config.h:127-130) ---
     profiling: bool = False

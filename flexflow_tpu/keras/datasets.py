@@ -72,7 +72,11 @@ class cifar100:
     @staticmethod
     def load_data(label_mode: str = "fine", n_train: int = 5000,
                   n_test: int = 1000) -> Arrays:
-        cached = _cache_path("cifar-100.npz")
+        # fine/coarse labels come from different caches — a fine-label npz
+        # must not satisfy a coarse-mode request
+        cache_name = ("cifar-100.npz" if label_mode == "fine"
+                      else "cifar-100-coarse.npz")
+        cached = _cache_path(cache_name)
         if cached:
             with np.load(cached, allow_pickle=True) as f:
                 return (f["x_train"], f["y_train"]), (f["x_test"], f["y_test"])

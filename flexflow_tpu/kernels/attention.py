@@ -45,9 +45,16 @@ def _pick_block_s(S: int) -> int:
 
 
 def supports_seq_len(S: int) -> bool:
-    """Single source of truth for dispatch guards in ops/ — True iff the
-    Pallas kernels here can tile a cache of length S."""
+    """True iff the Pallas kernels here can tile a cache of length S."""
     return _pick_block_s(S) > 0
+
+
+def supports_shapes(S: int, D: int) -> bool:
+    """Single source of truth for dispatch guards in ops/ — Mosaic requires
+    the trailing (lane) dim of a DMA slice to be 128-aligned, so the flash
+    kernels need head_dim % 128 == 0 in addition to a tileable cache
+    length. Callers fall back to the jnp path otherwise."""
+    return supports_seq_len(S) and D % 128 == 0
 
 
 def _kernel(len_ref,                       # scalar prefetch: [R] int32

@@ -1,0 +1,128 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+The reference implements its runtime in C++ with a flat C API consumed by
+Python cffi (src/c/flexflow_c.cc). Here the native surface covers the
+host-side components that are not XLA's job: the GPT-2 BPE tokenizer
+(reference src/runtime/gpt_tokenizer.cc) and the continuous-batching
+scheduler hot loop (reference src/runtime/request_manager.cc bookkeeping).
+
+The shared library is built lazily with g++ on first use (sources live in
+``native/`` at the repo root) and cached; every binding has a pure-Python
+fallback so the framework works even without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_BUILD_DIR = os.path.join(_NATIVE_DIR, "build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libflexflow_tpu_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _sources():
+    src = os.path.join(_NATIVE_DIR, "src")
+    return [os.path.join(src, f) for f in
+            ("bpe_tokenizer.cpp", "batch_scheduler.cpp")]
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    hdr = os.path.join(_NATIVE_DIR, "include", "flexflow_tpu_c.h")
+    return any(os.path.getmtime(p) > lib_mtime
+               for p in _sources() + [hdr] if os.path.exists(p))
+
+
+def _build() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-shared",
+           "-o", _LIB_PATH] + _sources()
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def _declare(lib: ctypes.CDLL):
+    c = ctypes
+    i32p = c.POINTER(c.c_int32)
+    u8p = c.POINTER(c.c_uint8)
+    lib.ffbpe_create.restype = c.c_void_p
+    lib.ffbpe_create.argtypes = [c.c_char_p, c.c_char_p]
+    lib.ffbpe_create_from_buffers.restype = c.c_void_p
+    lib.ffbpe_create_from_buffers.argtypes = [c.c_char_p, c.c_char_p]
+    lib.ffbpe_destroy.argtypes = [c.c_void_p]
+    lib.ffbpe_vocab_size.restype = c.c_int
+    lib.ffbpe_vocab_size.argtypes = [c.c_void_p]
+    lib.ffbpe_encode.restype = c.c_int
+    lib.ffbpe_encode.argtypes = [c.c_void_p, c.c_char_p, i32p, c.c_int]
+    lib.ffbpe_decode.restype = c.c_int
+    lib.ffbpe_decode.argtypes = [c.c_void_p, i32p, c.c_int, c.c_char_p,
+                                 c.c_int]
+
+    lib.ffs_create.restype = c.c_void_p
+    lib.ffs_create.argtypes = [c.c_int, c.c_int, c.c_int64]
+    lib.ffs_destroy.argtypes = [c.c_void_p]
+    lib.ffs_add_request.argtypes = [c.c_void_p, c.c_int64, i32p, c.c_int,
+                                    c.c_int, c.c_int]
+    lib.ffs_has_work.restype = c.c_int
+    lib.ffs_has_work.argtypes = [c.c_void_p]
+    lib.ffs_fill_slots.restype = c.c_int
+    lib.ffs_fill_slots.argtypes = [c.c_void_p]
+    lib.ffs_assemble_prefill.restype = c.c_int
+    lib.ffs_assemble_prefill.argtypes = [c.c_void_p, c.c_int, c.c_int,
+                                         c.c_int, i32p, i32p, i32p, i32p, u8p]
+    lib.ffs_assemble_decode.restype = c.c_int
+    lib.ffs_assemble_decode.argtypes = [c.c_void_p, i32p, i32p, u8p]
+    lib.ffs_decode_block.restype = c.c_int
+    lib.ffs_decode_block.argtypes = [c.c_void_p, c.c_int]
+    lib.ffs_append_block.restype = c.c_int
+    lib.ffs_append_block.argtypes = [c.c_void_p, i32p, c.c_int]
+    lib.ffs_pop_done.restype = c.c_int
+    lib.ffs_pop_done.argtypes = [c.c_void_p, c.POINTER(c.c_int64), i32p]
+    lib.ffs_done_tokens.restype = c.c_int
+    lib.ffs_done_tokens.argtypes = [c.c_void_p, c.c_int64, i32p, c.c_int]
+    lib.ffs_prompt_len.restype = c.c_int
+    lib.ffs_prompt_len.argtypes = [c.c_void_p, c.c_int64]
+
+
+def load_native() -> Optional[ctypes.CDLL]:
+    """Build (if needed) and load the native library; None if unavailable.
+
+    Disable with FF_DISABLE_NATIVE=1 (forces pure-Python fallbacks)."""
+    global _lib, _build_failed
+    if os.environ.get("FF_DISABLE_NATIVE") == "1":
+        return None
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if _needs_build() and not _build():
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            _declare(lib)
+            _lib = lib
+            return lib
+        except Exception:
+            _build_failed = True
+            return None
+
+
+def native_available() -> bool:
+    return load_native() is not None

@@ -156,7 +156,17 @@ class ONNXModel:
             t = ff.concat(ins, int(_attr(node, "axis", 0)), name=name)
         elif op == "Split":
             sizes = _attr(node, "split")
+            if sizes is None and len(node.inputs) > 1:
+                # opset >= 13 carries split sizes as a second input
+                sizes = [int(s) for s in init[node.inputs[1]]]
             axis = int(_attr(node, "axis", 0))
+            if sizes is None:     # equal split over the declared outputs
+                total = data(0).dims[axis]
+                k = len(node.outputs)
+                if total % k:
+                    raise NotImplementedError(
+                        f"Split without sizes: {total} not divisible by {k}")
+                sizes = [total // k] * k
             outs = ff.split(data(0), [int(s) for s in sizes], axis, name=name)
             for o_name, o_t in zip(node.outputs, outs):
                 env[o_name] = o_t

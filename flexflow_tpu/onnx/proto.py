@@ -149,14 +149,17 @@ def _decode_tensor(buf: bytes) -> Tuple[str, np.ndarray]:
                 float_data.extend(struct.unpack(f"<{len(val) // 4}f", val))
             else:
                 float_data.append(struct.unpack("<f", val)[0])
-        elif fnum == 5:      # packed int32_data
+        elif fnum == 5:      # packed int32_data (negatives sign-extend to
+            #                  64-bit varints, same as int64_data)
+            def _signed(v):
+                return v - (1 << 64) if v >= (1 << 63) else v
             if wtype == 2:
                 pos = 0
                 while pos < len(val):
                     v, pos = _read_varint(val, pos)
-                    int32_data.append(v)
+                    int32_data.append(_signed(v))
             else:
-                int32_data.append(val)
+                int32_data.append(_signed(val))
         elif fnum == 7:      # packed int64_data
             if wtype == 2:
                 pos = 0

@@ -125,8 +125,9 @@ def _attend(attrs, q, k_cache, v_cache, lengths, qpos, out_dtype, ctx,
              if attrs.get("position_bias", False) else None)
     S = k_cache.shape[2]
     cfg = ctx.config if ctx is not None else None
-    from flexflow_tpu.kernels.attention import supports_seq_len
-    if ffk.use_pallas(cfg) and supports_seq_len(S) and q.shape[1] <= 256:
+    from flexflow_tpu.kernels.attention import supports_shapes
+    if ffk.use_pallas(cfg) and supports_shapes(S, q.shape[-1]) \
+            and q.shape[1] <= 256:
         return flash_attend(
             q, k_cache, v_cache, lengths, qpos, bias=bias, alibi=alibi,
             causal=causal, qk_scale=scale, out_dtype=out_dtype,

@@ -218,6 +218,20 @@ class RequestManager:
         if ifm is None:
             ifm = model._inference_manager = InferenceManager(model)
         cfg = model.config
+        if getattr(cfg, "use_native_scheduler", True):
+            # Only the library load/construction may fall back; device
+            # errors inside the generation loop must propagate (requests
+            # have already been dequeued by then).
+            sched = None
+            try:
+                from flexflow_tpu.native.scheduler import NativeBatchScheduler
+                sched = NativeBatchScheduler(cfg.max_requests_per_batch,
+                                             cfg.max_sequence_length,
+                                             self.eos_token_id)
+            except RuntimeError:
+                pass  # no toolchain: pure-Python path below
+            if sched is not None:
+                return self._generate_incr_native(model, ifm, cfg, sched)
         R = cfg.max_requests_per_batch
         max_seq = cfg.max_sequence_length
         chunk = max(1, cfg.max_tokens_per_batch // max(1, min(R, 4)))
@@ -268,6 +282,51 @@ class RequestManager:
                 if req is not None and req.finished:
                     done.append(self._collect(req))
                     active[slot] = None
+        return done
+
+    def _generate_incr_native(self, model, ifm, cfg,
+                              sched) -> List[GenerationResult]:
+        """Incremental decoding with the native (C++) batch scheduler owning
+        slot fill, batch assembly, and EOS/limit bookkeeping
+        (native/src/batch_scheduler.cpp; same semantics as the Python loop
+        above — parity-tested in tests/test_native.py)."""
+        R = cfg.max_requests_per_batch
+        chunk = max(1, cfg.max_tokens_per_batch // max(1, min(R, 4)))
+        reqs: Dict[int, Request] = {}
+        while self.pending:
+            req = self.pending.popleft()
+            reqs[req.guid] = req
+            sched.add_request(req.guid, req.prompt_tokens,
+                              req.max_new_tokens, req.max_sequence_length)
+        done: List[GenerationResult] = []
+
+        def drain():
+            while True:
+                popped = sched.pop_done()
+                if popped is None:
+                    return
+                guid, tokens, _plen = popped
+                req = reqs[guid]
+                req.tokens = tokens
+                req.finished = True
+                done.append(self._collect(req))
+
+        while sched.has_work():
+            sched.fill_slots()
+            drain()  # over-long prompts rejected straight to done
+            rows, tokens, positions, start, num, act = \
+                sched.assemble_prefill(chunk, cfg.max_tokens_per_batch, chunk)
+            if rows:
+                ifm.step(BatchMeta(tokens=tokens, positions=positions,
+                                   start_pos=start, num_tokens=num,
+                                   active=act))
+                continue
+            live, tok, pos, act = sched.assemble_decode()
+            if live:
+                block = sched.decode_block(cfg.decode_block_steps)
+                toks = ifm.decode_block(tok, pos, act, block)
+                sched.append_block(np.asarray(toks)[:, :block])
+            drain()
         return done
 
     # =====================================================================

@@ -177,8 +177,12 @@ class PyTorchModel:
                   torch.matmul: "batch_matmul"}
         if t in binops:
             if len(ins) == 1 and scalars:     # tensor <op> scalar
+                # non-commutative ops need the operand order: `1.0 - x`
+                # traces with the scalar as args[0]
+                reverse = not isinstance(node.args[0], torch.fx.Node)
                 return IRNode("scalar_" + binops[t], name, ins,
-                              {"scalar": float(scalars[0])})
+                              {"scalar": float(scalars[0]),
+                               "reverse": reverse})
             return IRNode(binops[t], name, ins, {})
         if t in (torch.relu, F.relu):
             return IRNode("relu", name, ins, {})
@@ -356,11 +360,22 @@ def ir_to_ff(ir: List[IRNode], ffmodel, input_tensors: Sequence,
         elif n.op == "scalar_add":
             out = ffmodel.scalar_add(ins[0], a["scalar"], name=n.name)
         elif n.op == "scalar_subtract":
-            out = ffmodel.scalar_sub(ins[0], a["scalar"], name=n.name)
+            if a.get("reverse"):   # s - x = -x + s
+                out = ffmodel.scalar_add(
+                    ffmodel.scalar_multiply(ins[0], -1.0, name=n.name + "_neg"),
+                    a["scalar"], name=n.name)
+            else:
+                out = ffmodel.scalar_sub(ins[0], a["scalar"], name=n.name)
         elif n.op == "scalar_multiply":
             out = ffmodel.scalar_multiply(ins[0], a["scalar"], name=n.name)
         elif n.op == "scalar_divide":
-            out = ffmodel.scalar_true_divide(ins[0], a["scalar"], name=n.name)
+            if a.get("reverse"):   # s / x = s * x^-1
+                out = ffmodel.scalar_multiply(
+                    ffmodel.pow(ins[0], -1.0, name=n.name + "_inv"),
+                    a["scalar"], name=n.name)
+            else:
+                out = ffmodel.scalar_true_divide(ins[0], a["scalar"],
+                                                 name=n.name)
         elif n.op in ("relu", "sigmoid", "tanh", "gelu", "elu", "identity"):
             out = getattr(ffmodel, n.op)(ins[0], name=n.name)
         elif n.op == "concat":

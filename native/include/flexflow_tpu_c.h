@@ -1,0 +1,103 @@
+/* Flat C API for the native runtime components of flexflow_tpu.
+ *
+ * Capability parity with the reference's native layer: the GPT-2 byte-level
+ * BPE tokenizer (reference src/runtime/gpt_tokenizer.cc, 324 LoC) and the
+ * continuous-batching request scheduler's host-side hot loop (reference
+ * src/runtime/request_manager.cc slot fill / batch assembly). The Python
+ * runtime binds these via ctypes (reference used a cffi C API,
+ * src/c/flexflow_c.cc); device compute stays in XLA/Pallas.
+ */
+
+#ifndef FLEXFLOW_TPU_C_H
+#define FLEXFLOW_TPU_C_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---------------- GPT-2 byte-level BPE tokenizer ---------------- */
+
+/* Create from vocab.json ({"token": id, ...}) and merges.txt file paths.
+ * Returns NULL on error. */
+void *ffbpe_create(const char *vocab_json_path, const char *merges_path);
+
+/* Create from in-memory buffers (NUL-terminated). */
+void *ffbpe_create_from_buffers(const char *vocab_json, const char *merges);
+
+void ffbpe_destroy(void *handle);
+
+int ffbpe_vocab_size(void *handle);
+
+/* Encode UTF-8 text into ids. Returns the number of ids produced, or a
+ * negative value whose magnitude is the required capacity if cap is too
+ * small. */
+int ffbpe_encode(void *handle, const char *text, int32_t *out_ids, int cap);
+
+/* Decode ids to UTF-8. Returns bytes written (excluding NUL), or negative
+ * required capacity. */
+int ffbpe_decode(void *handle, const int32_t *ids, int n, char *out, int cap);
+
+/* ---------------- continuous-batching scheduler ---------------- */
+
+/* Create a scheduler with R request slots, a max KV length of max_seq and
+ * an optional EOS id (pass -1 for none). */
+void *ffs_create(int max_requests, int max_seq, int64_t eos_id);
+
+void ffs_destroy(void *handle);
+
+/* Queue a request. tokens are the prompt; max_new bounds generation;
+ * max_seq_len (0 = no per-request bound) caps prompt+generation. */
+void ffs_add_request(void *handle, int64_t guid, const int32_t *tokens,
+                     int n_tokens, int max_new, int max_seq_len);
+
+/* Non-zero while any request is pending or active. */
+int ffs_has_work(void *handle);
+
+/* Move pending requests into free slots. Over-long prompts (no room to
+ * generate a single token) are rejected straight to the done queue.
+ * Returns the number of requests newly placed in slots. */
+int ffs_fill_slots(void *handle);
+
+/* Assemble a prefill batch: for every active slot with >1 pending
+ * (uncached) prompt tokens, emit up to `chunk` of them (leaving >=1 pending
+ * so the final chunk produces the first generated token), bounded by a
+ * total token budget. Writes [R x Q] tokens/positions and per-slot
+ * start/num/active arrays, advances each slot's cache depth, and returns
+ * the number of rows emitted (0 = no prefill work; proceed to decode). */
+int ffs_assemble_prefill(void *handle, int chunk, int budget, int Q,
+                         int32_t *tokens, int32_t *positions,
+                         int32_t *start_pos, int32_t *num_tokens,
+                         uint8_t *active);
+
+/* Assemble a decode step: per live slot the last token and its position.
+ * Returns the number of live slots. */
+int ffs_assemble_decode(void *handle, int32_t *tok, int32_t *pos,
+                        uint8_t *active);
+
+/* Largest safe fused-decode block size: min over live slots of remaining
+ * generation budget, clamped to max_block and to the KV cache end. */
+int ffs_decode_block(void *handle, int max_block);
+
+/* Feed back a [R x B] block of sampled tokens after a fused decode. Applies
+ * EOS/length termination per slot, frees finished slots to the done queue.
+ * Returns the number of requests finished by this block. */
+int ffs_append_block(void *handle, const int32_t *toks, int B);
+
+/* Drain the done queue: returns guid and token count of the next finished
+ * request, or 0 if none. */
+int ffs_pop_done(void *handle, int64_t *guid, int32_t *n_tokens);
+
+/* Copy the full token sequence (prompt + generated) of a finished request
+ * popped by ffs_pop_done. Returns tokens written. Also releases it. */
+int ffs_done_tokens(void *handle, int64_t guid, int32_t *out, int cap);
+
+/* Number of prompt tokens for a request (for output splitting). */
+int ffs_prompt_len(void *handle, int64_t guid);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* FLEXFLOW_TPU_C_H */

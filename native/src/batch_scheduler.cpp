@@ -1,0 +1,241 @@
+// Continuous-batching request scheduler: host-side hot loop in native code.
+//
+// Capability parity with the slot/bookkeeping core of the reference's
+// RequestManager (src/runtime/request_manager.cc: register_new_request,
+// prepare_next_batch slot fill + token bookkeeping). The Python
+// RequestManager delegates per-step batch assembly and token-feedback
+// bookkeeping here; XLA runs the device side. Semantics mirror
+// flexflow_tpu/serve/request_manager.py exactly (parity-tested).
+
+#include "../include/flexflow_tpu_c.h"
+
+#include <algorithm>
+#include <cstring>
+#include <deque>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Req {
+  int64_t guid = 0;
+  std::vector<int32_t> tokens;   // prompt + generated
+  int prompt_len = 0;
+  int max_new = 0;
+  int max_seq_len = 0;           // 0 = unbounded (use scheduler max_seq)
+  int cache_depth = 0;           // tokens already in KV cache
+  int generated = 0;
+  int slot = -1;
+  bool finished = false;
+};
+
+struct Sched {
+  int R = 0;
+  int max_seq = 0;
+  int64_t eos = -1;
+  std::deque<Req *> pending;
+  std::vector<Req *> active;                    // size R, nullable
+  std::deque<Req *> done;                       // finished, not yet drained
+  std::unordered_map<int64_t, Req *> drained;   // popped, awaiting readout
+
+  explicit Sched(int r, int ms, int64_t e) : R(r), max_seq(ms), eos(e) {
+    active.assign(R, nullptr);
+  }
+
+  ~Sched() {
+    for (Req *r : pending) delete r;
+    for (Req *r : active)
+      if (r) delete r;
+    for (Req *r : done) delete r;
+    for (auto &kv : drained) delete kv.second;
+  }
+
+  int limit_of(const Req *r) const {
+    int lim = r->max_seq_len > 0 ? std::min(r->max_seq_len, max_seq) : max_seq;
+    return lim;
+  }
+
+  // mirror of request_manager.py _finish_if_done
+  bool finish_if_done(Req *r) {
+    int lim = limit_of(r);
+    if ((int)r->tokens.size() > lim) r->tokens.resize(lim);
+    if (r->generated >= r->max_new || (int)r->tokens.size() >= lim ||
+        (eos >= 0 && r->generated > 0 && r->tokens.back() == (int32_t)eos)) {
+      r->finished = true;
+    }
+    return r->finished;
+  }
+
+  int remaining_budget(const Req *r) const {
+    int lim = limit_of(r);
+    return std::max(1, std::min(r->max_new - r->generated,
+                                lim - (int)r->tokens.size()));
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void *ffs_create(int max_requests, int max_seq, int64_t eos_id) {
+  return new Sched(max_requests, max_seq, eos_id);
+}
+
+void ffs_destroy(void *handle) { delete static_cast<Sched *>(handle); }
+
+void ffs_add_request(void *handle, int64_t guid, const int32_t *tokens,
+                     int n_tokens, int max_new, int max_seq_len) {
+  auto *s = static_cast<Sched *>(handle);
+  Req *r = new Req();
+  r->guid = guid;
+  r->tokens.assign(tokens, tokens + n_tokens);
+  r->prompt_len = n_tokens;
+  r->max_new = max_new;
+  r->max_seq_len = max_seq_len;
+  s->pending.push_back(r);
+}
+
+int ffs_has_work(void *handle) {
+  auto *s = static_cast<Sched *>(handle);
+  if (!s->pending.empty()) return 1;
+  for (Req *r : s->active)
+    if (r) return 1;
+  return 0;
+}
+
+int ffs_fill_slots(void *handle) {
+  auto *s = static_cast<Sched *>(handle);
+  int placed = 0;
+  for (int slot = 0; slot < s->R; ++slot) {
+    while (s->active[slot] == nullptr && !s->pending.empty()) {
+      Req *r = s->pending.front();
+      s->pending.pop_front();
+      if ((int)r->tokens.size() >= s->limit_of(r)) {
+        // no room to generate even one token: reject to done
+        r->finished = true;
+        s->done.push_back(r);
+        continue;
+      }
+      r->slot = slot;
+      s->active[slot] = r;
+      ++placed;
+    }
+  }
+  return placed;
+}
+
+int ffs_assemble_prefill(void *handle, int chunk, int budget, int Q,
+                         int32_t *tokens, int32_t *positions,
+                         int32_t *start_pos, int32_t *num_tokens,
+                         uint8_t *active) {
+  auto *s = static_cast<Sched *>(handle);
+  memset(tokens, 0, sizeof(int32_t) * s->R * Q);
+  memset(positions, 0, sizeof(int32_t) * s->R * Q);
+  memset(start_pos, 0, sizeof(int32_t) * s->R);
+  memset(num_tokens, 0, sizeof(int32_t) * s->R);
+  memset(active, 0, s->R);
+  int rows = 0;
+  for (Req *r : s->active) {
+    if (!r || r->finished) continue;
+    int d = r->cache_depth;
+    int npend = (int)r->tokens.size() - d;
+    if (npend > 1) {
+      int take = std::min({npend - 1, chunk, budget});
+      if (take <= 0) continue;
+      for (int j = 0; j < take; ++j) {
+        tokens[r->slot * Q + j] = r->tokens[d + j];
+        positions[r->slot * Q + j] = d + j;
+      }
+      start_pos[r->slot] = d;
+      num_tokens[r->slot] = take;
+      active[r->slot] = 1;
+      budget -= take;
+      r->cache_depth = d + take;
+      ++rows;
+    }
+  }
+  return rows;
+}
+
+int ffs_assemble_decode(void *handle, int32_t *tok, int32_t *pos,
+                        uint8_t *active) {
+  auto *s = static_cast<Sched *>(handle);
+  memset(tok, 0, sizeof(int32_t) * s->R);
+  memset(pos, 0, sizeof(int32_t) * s->R);
+  memset(active, 0, s->R);
+  int live = 0;
+  for (Req *r : s->active) {
+    if (!r || r->finished) continue;
+    tok[r->slot] = r->tokens.back();
+    pos[r->slot] = (int)r->tokens.size() - 1;
+    active[r->slot] = 1;
+    ++live;
+  }
+  return live;
+}
+
+int ffs_decode_block(void *handle, int max_block) {
+  auto *s = static_cast<Sched *>(handle);
+  int block = 0;
+  int max_pos = -1;
+  for (Req *r : s->active) {
+    if (!r || r->finished) continue;
+    block = std::max(block, s->remaining_budget(r));
+    max_pos = std::max(max_pos, (int)r->tokens.size() - 1);
+  }
+  if (max_pos < 0) return 0;
+  block = std::min(block, max_block);
+  block = std::min(block, s->max_seq - 1 - max_pos);
+  return std::max(1, block);
+}
+
+int ffs_append_block(void *handle, const int32_t *toks, int B) {
+  auto *s = static_cast<Sched *>(handle);
+  int finished = 0;
+  for (int slot = 0; slot < s->R; ++slot) {
+    Req *r = s->active[slot];
+    if (!r || r->finished) continue;
+    for (int j = 0; j < B; ++j) {
+      r->tokens.push_back(toks[slot * B + j]);
+      r->generated += 1;
+      if (s->finish_if_done(r)) break;
+    }
+    r->cache_depth = (int)r->tokens.size() - 1;
+    if (r->finished) {
+      s->done.push_back(r);
+      s->active[slot] = nullptr;
+      ++finished;
+    }
+  }
+  return finished;
+}
+
+int ffs_pop_done(void *handle, int64_t *guid, int32_t *n_tokens) {
+  auto *s = static_cast<Sched *>(handle);
+  if (s->done.empty()) return 0;
+  Req *r = s->done.front();
+  s->done.pop_front();
+  *guid = r->guid;
+  *n_tokens = (int32_t)r->tokens.size();
+  s->drained[r->guid] = r;
+  return 1;
+}
+
+int ffs_done_tokens(void *handle, int64_t guid, int32_t *out, int cap) {
+  auto *s = static_cast<Sched *>(handle);
+  auto it = s->drained.find(guid);
+  if (it == s->drained.end()) return 0;
+  Req *r = it->second;
+  int n = std::min((int)r->tokens.size(), cap);
+  memcpy(out, r->tokens.data(), n * sizeof(int32_t));
+  return n;
+}
+
+int ffs_prompt_len(void *handle, int64_t guid) {
+  auto *s = static_cast<Sched *>(handle);
+  auto it = s->drained.find(guid);
+  if (it == s->drained.end()) return 0;
+  return it->second->prompt_len;
+}
+
+}  // extern "C"

@@ -1,0 +1,450 @@
+// GPT-2 byte-level BPE tokenizer (native).
+//
+// Capability parity with reference src/runtime/gpt_tokenizer.cc (324 LoC):
+// byte-to-unicode mapping, greedy rank-ordered pair merging over a merges
+// table, vocab.json id lookup, and GPT-2-style pre-tokenization (contractions,
+// letter/number/other runs with a leading-space convention). Implemented
+// fresh against the published BPE algorithm; no reference code copied.
+
+#include "../include/flexflow_tpu_c.h"
+
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------- UTF-8 helpers ----------------
+
+void append_utf8(std::string &out, uint32_t cp) {
+  if (cp < 0x80) {
+    out.push_back(static_cast<char>(cp));
+  } else if (cp < 0x800) {
+    out.push_back(static_cast<char>(0xC0 | (cp >> 6)));
+    out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  } else if (cp < 0x10000) {
+    out.push_back(static_cast<char>(0xE0 | (cp >> 12)));
+    out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+    out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  } else {
+    out.push_back(static_cast<char>(0xF0 | (cp >> 18)));
+    out.push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+    out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+    out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  }
+}
+
+uint32_t next_codepoint(const std::string &s, size_t &i) {
+  unsigned char c = s[i];
+  if (c < 0x80) { i += 1; return c; }
+  if ((c >> 5) == 0x6 && i + 1 < s.size()) {
+    uint32_t cp = ((c & 0x1F) << 6) | (s[i + 1] & 0x3F);
+    i += 2; return cp;
+  }
+  if ((c >> 4) == 0xE && i + 2 < s.size()) {
+    uint32_t cp = ((c & 0x0F) << 12) | ((s[i + 1] & 0x3F) << 6) |
+                  (s[i + 2] & 0x3F);
+    i += 3; return cp;
+  }
+  if ((c >> 3) == 0x1E && i + 3 < s.size()) {
+    uint32_t cp = ((c & 0x07) << 18) | ((s[i + 1] & 0x3F) << 12) |
+                  ((s[i + 2] & 0x3F) << 6) | (s[i + 3] & 0x3F);
+    i += 4; return cp;
+  }
+  i += 1;  // invalid byte: skip
+  return 0xFFFD;
+}
+
+// ---------------- byte <-> unicode (GPT-2 bytes_to_unicode) ----------------
+
+struct ByteUnicode {
+  uint32_t byte_to_cp[256];
+  std::unordered_map<uint32_t, uint8_t> cp_to_byte;
+
+  ByteUnicode() {
+    // printable ranges map to themselves; the rest shift to 256+n
+    std::vector<int> bs;
+    for (int b = '!'; b <= '~'; ++b) bs.push_back(b);
+    for (int b = 0xA1; b <= 0xAC; ++b) bs.push_back(b);
+    for (int b = 0xAE; b <= 0xFF; ++b) bs.push_back(b);
+    bool used[256] = {false};
+    for (int b : bs) { byte_to_cp[b] = b; used[b] = true; }
+    int n = 0;
+    for (int b = 0; b < 256; ++b) {
+      if (!used[b]) { byte_to_cp[b] = 256 + n; ++n; }
+    }
+    for (int b = 0; b < 256; ++b) cp_to_byte[byte_to_cp[b]] = (uint8_t)b;
+  }
+};
+
+const ByteUnicode &byte_unicode() {
+  static ByteUnicode bu;
+  return bu;
+}
+
+// ---------------- minimal JSON {string: int} parser ----------------
+
+bool parse_json_string(const std::string &s, size_t &i, std::string &out) {
+  if (s[i] != '"') return false;
+  ++i;
+  out.clear();
+  while (i < s.size() && s[i] != '"') {
+    char c = s[i];
+    if (c == '\\' && i + 1 < s.size()) {
+      char e = s[++i];
+      switch (e) {
+        case 'n': out.push_back('\n'); break;
+        case 't': out.push_back('\t'); break;
+        case 'r': out.push_back('\r'); break;
+        case 'b': out.push_back('\b'); break;
+        case 'f': out.push_back('\f'); break;
+        case '"': out.push_back('"'); break;
+        case '\\': out.push_back('\\'); break;
+        case '/': out.push_back('/'); break;
+        case 'u': {
+          if (i + 4 >= s.size()) return false;
+          uint32_t cp = (uint32_t)strtol(s.substr(i + 1, 4).c_str(),
+                                         nullptr, 16);
+          i += 4;
+          // surrogate pair
+          if (cp >= 0xD800 && cp <= 0xDBFF && i + 6 < s.size() &&
+              s[i + 1] == '\\' && s[i + 2] == 'u') {
+            uint32_t lo = (uint32_t)strtol(s.substr(i + 3, 4).c_str(),
+                                           nullptr, 16);
+            if (lo >= 0xDC00 && lo <= 0xDFFF) {
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+              i += 6;
+            }
+          }
+          append_utf8(out, cp);
+          break;
+        }
+        default: out.push_back(e);
+      }
+      ++i;
+    } else {
+      out.push_back(c);
+      ++i;
+    }
+  }
+  if (i >= s.size()) return false;
+  ++i;  // closing quote
+  return true;
+}
+
+void skip_ws(const std::string &s, size_t &i) {
+  while (i < s.size() && (s[i] == ' ' || s[i] == '\n' || s[i] == '\t' ||
+                          s[i] == '\r' || s[i] == ','))
+    ++i;
+}
+
+bool parse_vocab_json(const std::string &text,
+                      std::unordered_map<std::string, int32_t> &vocab) {
+  size_t i = 0;
+  skip_ws(text, i);
+  if (i >= text.size() || text[i] != '{') return false;
+  ++i;
+  while (true) {
+    skip_ws(text, i);
+    if (i >= text.size()) return false;
+    if (text[i] == '}') return true;
+    std::string key;
+    if (!parse_json_string(text, i, key)) return false;
+    skip_ws(text, i);
+    if (i >= text.size() || text[i] != ':') return false;
+    ++i;
+    skip_ws(text, i);
+    size_t end = i;
+    while (end < text.size() &&
+           (isdigit((unsigned char)text[end]) || text[end] == '-'))
+      ++end;
+    vocab[key] = (int32_t)strtol(text.substr(i, end - i).c_str(), nullptr, 10);
+    i = end;
+  }
+}
+
+// ---------------- pre-tokenization ----------------
+
+// Approximates the GPT-2 split regex:
+//   's|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+
+// Unicode letters beyond ASCII are classified as letters by codepoint range.
+bool cp_is_letter(uint32_t cp) {
+  if ((cp >= 'a' && cp <= 'z') || (cp >= 'A' && cp <= 'Z')) return true;
+  if (cp >= 0xC0 && cp < 0x2000 && cp != 0xD7 && cp != 0xF7) return true;
+  if (cp >= 0x2C00 && cp < 0xE000) return true;   // CJK etc.
+  if (cp >= 0x10000) return true;
+  return false;
+}
+
+bool cp_is_digit(uint32_t cp) { return cp >= '0' && cp <= '9'; }
+
+bool cp_is_space(uint32_t cp) {
+  return cp == ' ' || cp == '\t' || cp == '\n' || cp == '\r' || cp == 0x0B ||
+         cp == 0x0C || cp == 0xA0;
+}
+
+std::vector<std::string> pretokenize(const std::string &text) {
+  std::vector<std::string> pieces;
+  // decode into codepoints with byte offsets
+  std::vector<uint32_t> cps;
+  std::vector<size_t> offs;
+  size_t i = 0;
+  while (i < text.size()) {
+    offs.push_back(i);
+    cps.push_back(next_codepoint(text, i));
+  }
+  offs.push_back(text.size());
+  size_t n = cps.size();
+  size_t p = 0;
+  auto slice = [&](size_t a, size_t b) {
+    return text.substr(offs[a], offs[b] - offs[a]);
+  };
+  static const char *contractions[] = {"'s", "'t", "'re", "'ve",
+                                       "'m", "'ll", "'d"};
+  while (p < n) {
+    // contractions
+    if (cps[p] == '\'') {
+      bool matched = false;
+      for (const char *c : contractions) {
+        size_t len = strlen(c);
+        // compare against ASCII codepoints
+        if (p + len <= n) {
+          bool ok = true;
+          for (size_t k = 0; k < len; ++k)
+            if (cps[p + k] != (uint32_t)c[k]) { ok = false; break; }
+          if (ok) {
+            pieces.push_back(slice(p, p + len));
+            p += len;
+            matched = true;
+            break;
+          }
+        }
+      }
+      if (matched) continue;
+    }
+    size_t start = p;
+    bool leading_space = false;
+    if (cp_is_space(cps[p]) && p + 1 < n &&
+        (cp_is_letter(cps[p + 1]) || cp_is_digit(cps[p + 1]) ||
+         (!cp_is_space(cps[p + 1])))) {
+      // single space absorbed into the following run — but only if exactly
+      // one space precedes a non-space (regex " ?..."); multiple spaces are
+      // handled by the \s+ branches below.
+      if (cps[p] == ' ' && !cp_is_space(cps[p + 1])) {
+        leading_space = true;
+        ++p;
+      }
+    }
+    if (p < n && cp_is_letter(cps[p])) {
+      while (p < n && cp_is_letter(cps[p])) ++p;
+      pieces.push_back(slice(start, p));
+      continue;
+    }
+    if (p < n && cp_is_digit(cps[p])) {
+      while (p < n && cp_is_digit(cps[p])) ++p;
+      pieces.push_back(slice(start, p));
+      continue;
+    }
+    if (p < n && !cp_is_space(cps[p])) {
+      while (p < n && !cp_is_space(cps[p]) && !cp_is_letter(cps[p]) &&
+             !cp_is_digit(cps[p]))
+        ++p;
+      pieces.push_back(slice(start, p));
+      continue;
+    }
+    if (leading_space) {
+      // lone space before a space-run: fall through to whitespace handling
+      p = start;
+    }
+    // whitespace runs: \s+(?!\S) takes all but trailing space kept for the
+    // next token, \s+ otherwise
+    size_t q = p;
+    while (q < n && cp_is_space(cps[q])) ++q;
+    if (q < n && q - p > 1) {
+      pieces.push_back(slice(p, q - 1));  // \s+(?!\S)
+      p = q - 1;
+    } else {
+      pieces.push_back(slice(p, q));
+      p = q;
+    }
+  }
+  return pieces;
+}
+
+// ---------------- tokenizer object ----------------
+
+struct PairHash {
+  size_t operator()(const std::pair<std::string, std::string> &p) const {
+    return std::hash<std::string>()(p.first) * 31 +
+           std::hash<std::string>()(p.second);
+  }
+};
+
+struct BPETokenizer {
+  std::unordered_map<std::string, int32_t> vocab;
+  std::vector<std::string> id_to_token;
+  std::unordered_map<std::pair<std::string, std::string>, int, PairHash> ranks;
+  std::unordered_map<std::string, std::vector<int32_t>> cache;
+
+  bool load(const std::string &vocab_json, const std::string &merges) {
+    if (!parse_vocab_json(vocab_json, vocab)) return false;
+    int32_t max_id = 0;
+    for (auto &kv : vocab) max_id = std::max(max_id, kv.second);
+    id_to_token.assign(max_id + 1, "");
+    for (auto &kv : vocab) id_to_token[kv.second] = kv.first;
+    std::istringstream ms(merges);
+    std::string line;
+    int rank = 0;
+    while (std::getline(ms, line)) {
+      if (line.empty() || line[0] == '#') continue;
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      size_t sp = line.find(' ');
+      if (sp == std::string::npos) continue;
+      ranks[{line.substr(0, sp), line.substr(sp + 1)}] = rank++;
+    }
+    return true;
+  }
+
+  // split a byte-encoded word into unicode "characters" (strings)
+  std::vector<std::string> chars_of(const std::string &word) {
+    std::vector<std::string> out;
+    size_t i = 0;
+    while (i < word.size()) {
+      size_t j = i;
+      next_codepoint(word, j);
+      out.push_back(word.substr(i, j - i));
+      i = j;
+    }
+    return out;
+  }
+
+  std::vector<int32_t> bpe(const std::string &piece) {
+    auto it = cache.find(piece);
+    if (it != cache.end()) return it->second;
+    // byte-encode
+    std::string word;
+    for (unsigned char b : piece) append_utf8(word, byte_unicode().byte_to_cp[b]);
+    std::vector<std::string> parts = chars_of(word);
+    while (parts.size() > 1) {
+      int best_rank = INT32_MAX;
+      size_t best_i = 0;
+      for (size_t i = 0; i + 1 < parts.size(); ++i) {
+        auto r = ranks.find({parts[i], parts[i + 1]});
+        if (r != ranks.end() && r->second < best_rank) {
+          best_rank = r->second;
+          best_i = i;
+        }
+      }
+      if (best_rank == INT32_MAX) break;
+      std::vector<std::string> merged;
+      merged.reserve(parts.size() - 1);
+      for (size_t i = 0; i < parts.size();) {
+        if (i == best_i) {
+          merged.push_back(parts[i] + parts[i + 1]);
+          i += 2;
+        } else {
+          merged.push_back(parts[i]);
+          i += 1;
+        }
+      }
+      parts.swap(merged);
+    }
+    std::vector<int32_t> ids;
+    ids.reserve(parts.size());
+    for (auto &p : parts) {
+      auto v = vocab.find(p);
+      if (v != vocab.end()) {
+        ids.push_back(v->second);
+      } else {
+        // unknown merged unit: emit per-char ids when present
+        for (auto &c : chars_of(p)) {
+          auto cv = vocab.find(c);
+          if (cv != vocab.end()) ids.push_back(cv->second);
+        }
+      }
+    }
+    if (cache.size() < (1u << 20)) cache[piece] = ids;
+    return ids;
+  }
+
+  std::vector<int32_t> encode(const std::string &text) {
+    std::vector<int32_t> out;
+    for (auto &piece : pretokenize(text)) {
+      auto ids = bpe(piece);
+      out.insert(out.end(), ids.begin(), ids.end());
+    }
+    return out;
+  }
+
+  std::string decode(const int32_t *ids, int n) {
+    std::string unicode;
+    for (int i = 0; i < n; ++i) {
+      if (ids[i] >= 0 && ids[i] < (int32_t)id_to_token.size())
+        unicode += id_to_token[ids[i]];
+    }
+    std::string bytes;
+    size_t i = 0;
+    while (i < unicode.size()) {
+      uint32_t cp = next_codepoint(unicode, i);
+      auto it = byte_unicode().cp_to_byte.find(cp);
+      if (it != byte_unicode().cp_to_byte.end())
+        bytes.push_back((char)it->second);
+    }
+    return bytes;
+  }
+};
+
+std::string read_file(const char *path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return "";
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+}  // namespace
+
+extern "C" {
+
+void *ffbpe_create_from_buffers(const char *vocab_json, const char *merges) {
+  auto *t = new BPETokenizer();
+  if (!t->load(vocab_json ? vocab_json : "", merges ? merges : "")) {
+    delete t;
+    return nullptr;
+  }
+  return t;
+}
+
+void *ffbpe_create(const char *vocab_json_path, const char *merges_path) {
+  std::string vocab = read_file(vocab_json_path);
+  std::string merges = read_file(merges_path);
+  if (vocab.empty()) return nullptr;
+  return ffbpe_create_from_buffers(vocab.c_str(), merges.c_str());
+}
+
+void ffbpe_destroy(void *handle) { delete static_cast<BPETokenizer *>(handle); }
+
+int ffbpe_vocab_size(void *handle) {
+  return (int)static_cast<BPETokenizer *>(handle)->vocab.size();
+}
+
+int ffbpe_encode(void *handle, const char *text, int32_t *out_ids, int cap) {
+  auto ids = static_cast<BPETokenizer *>(handle)->encode(text);
+  if ((int)ids.size() > cap) return -(int)ids.size();
+  memcpy(out_ids, ids.data(), ids.size() * sizeof(int32_t));
+  return (int)ids.size();
+}
+
+int ffbpe_decode(void *handle, const int32_t *ids, int n, char *out, int cap) {
+  std::string s = static_cast<BPETokenizer *>(handle)->decode(ids, n);
+  if ((int)s.size() + 1 > cap) return -((int)s.size() + 1);
+  memcpy(out, s.data(), s.size());
+  out[s.size()] = '\0';
+  return (int)s.size();
+}
+
+}  // extern "C"

@@ -85,6 +85,16 @@ def test_residual_scalar_layernorm_alignment():
     _align(ResidualBlock(), x, 8)
 
 
+class ReversedScalars(nn.Module):
+    def forward(self, x):
+        return 2.0 / (1.0 - torch.sigmoid(x))   # scalar on the left
+
+
+def test_reversed_scalar_ops_alignment():
+    x = np.random.RandomState(4).randn(8, 16).astype(np.float32)
+    _align(ReversedScalars(), x, 8)
+
+
 def test_file_ir_roundtrip(tmp_path):
     module = MLP()
     pt = PyTorchModel(module)
