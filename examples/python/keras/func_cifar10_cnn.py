@@ -1,0 +1,47 @@
+"""Functional CIFAR-10 CNN (reference examples/python/keras/
+func_cifar10_cnn.py)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu.keras as keras
+from flexflow_tpu.keras.datasets import cifar10
+from flexflow_tpu.keras.layers import (
+    Conv2D,
+    Dense,
+    Flatten,
+    Input,
+    MaxPooling2D,
+)
+from flexflow_tpu.keras.models import Model
+
+
+def top_level_task():
+    (x_train, y_train), _ = cifar10.load_data(n_train=2048)
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    inp = Input(shape=(3, 32, 32))
+    x = Conv2D(32, (3, 3), activation="relu")(inp)
+    x = Conv2D(32, (3, 3), activation="relu")(x)
+    x = MaxPooling2D(pool_size=(2, 2))(x)
+    x = Conv2D(64, (3, 3), activation="relu")(x)
+    x = MaxPooling2D(pool_size=(2, 2))(x)
+    x = Flatten()(x)
+    x = Dense(256, activation="relu")(x)
+    out = Dense(10, activation="softmax")(x)
+
+    model = Model(inputs=inp, outputs=out)
+    model.compile(optimizer=keras.optimizers.Adam(learning_rate=1e-3),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x_train, y_train, epochs=3)
+
+
+if __name__ == "__main__":
+    top_level_task()

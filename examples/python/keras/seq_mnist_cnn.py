@@ -1,0 +1,38 @@
+"""Sequential MNIST CNN (reference examples/python/keras/seq_mnist_cnn.py)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu.keras as keras
+from flexflow_tpu.keras.datasets import mnist
+from flexflow_tpu.keras.layers import Conv2D, Dense, Flatten, MaxPooling2D
+from flexflow_tpu.keras.models import Sequential
+
+
+def top_level_task():
+    (x_train, y_train), _ = mnist.load_data(n_train=4096)
+    x_train = x_train.reshape(-1, 1, 28, 28).astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    model = Sequential()
+    model.add(Conv2D(32, (3, 3), activation="relu",
+                     input_shape=(1, 28, 28)))
+    model.add(Conv2D(64, (3, 3), activation="relu"))
+    model.add(MaxPooling2D(pool_size=(2, 2)))
+    model.add(Flatten())
+    model.add(Dense(128, activation="relu"))
+    model.add(Dense(10, activation="softmax"))
+    model.summary()
+    model.compile(optimizer=keras.optimizers.SGD(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x_train, y_train, epochs=2)
+
+
+if __name__ == "__main__":
+    top_level_task()

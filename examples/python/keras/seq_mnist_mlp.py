@@ -1,0 +1,35 @@
+"""Sequential MNIST MLP (reference examples/python/keras/seq_mnist_mlp.py)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu.keras as keras
+from flexflow_tpu.keras.callbacks import VerifyMetrics
+from flexflow_tpu.keras.datasets import mnist
+from flexflow_tpu.keras.layers import Dense
+from flexflow_tpu.keras.models import Sequential
+
+
+def top_level_task():
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 784).astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    model = Sequential()
+    model.add(Dense(512, activation="relu", input_shape=(784,)))
+    model.add(Dense(512, activation="relu"))
+    model.add(Dense(10, activation="softmax"))
+    model.compile(optimizer=keras.optimizers.SGD(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x_train, y_train, epochs=4,
+              callbacks=[VerifyMetrics(accuracy_threshold=0.6)])
+
+
+if __name__ == "__main__":
+    top_level_task()

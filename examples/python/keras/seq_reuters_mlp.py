@@ -1,0 +1,47 @@
+"""Reuters topic MLP (reference examples/python/keras/seq_reuters_mlp.py):
+bag-of-words features from padded sequences."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu.keras as keras
+from flexflow_tpu.keras.datasets import reuters
+from flexflow_tpu.keras.layers import Dense, Dropout
+from flexflow_tpu.keras.models import Sequential
+from flexflow_tpu.keras.preprocessing import sequence
+
+NUM_WORDS = 1000
+NUM_CLASSES = 46
+
+
+def vectorize(seqs, dim):
+    out = np.zeros((len(seqs), dim), np.float32)
+    for i, s in enumerate(seqs):
+        for t in s:
+            if 0 <= t < dim:
+                out[i, t] = 1.0
+    return out
+
+
+def top_level_task():
+    (x_train, y_train), _ = reuters.load_data(num_words=NUM_WORDS)
+    x_train = vectorize(x_train, NUM_WORDS)
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    model = Sequential()
+    model.add(Dense(256, activation="relu", input_shape=(NUM_WORDS,)))
+    model.add(Dropout(0.3))
+    model.add(Dense(NUM_CLASSES, activation="softmax"))
+    model.compile(optimizer=keras.optimizers.Adam(learning_rate=1e-3),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x_train, y_train, epochs=4)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,57 @@
+"""AlexNet (reference examples/cpp/AlexNet/alexnet.cc:104, python twin
+examples/python/native/alexnet.py). Synthetic 3x229x229 input like the
+reference's generated dataset.
+
+Run: python examples/python/native/alexnet.py [-b 16] [-e 1]
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu as ff
+
+
+def build_alexnet(model, t):
+    x = model.conv2d(t, 64, 11, 11, 4, 4, 2, 2, ff.ActiMode.AC_MODE_RELU)
+    x = model.pool2d(x, 3, 3, 2, 2, 0, 0)
+    x = model.conv2d(x, 192, 5, 5, 1, 1, 2, 2, ff.ActiMode.AC_MODE_RELU)
+    x = model.pool2d(x, 3, 3, 2, 2, 0, 0)
+    x = model.conv2d(x, 384, 3, 3, 1, 1, 1, 1, ff.ActiMode.AC_MODE_RELU)
+    x = model.conv2d(x, 256, 3, 3, 1, 1, 1, 1, ff.ActiMode.AC_MODE_RELU)
+    x = model.conv2d(x, 256, 3, 3, 1, 1, 1, 1, ff.ActiMode.AC_MODE_RELU)
+    x = model.pool2d(x, 3, 3, 2, 2, 0, 0)
+    x = model.flat(x)
+    x = model.dense(x, 4096, ff.ActiMode.AC_MODE_RELU)
+    x = model.dense(x, 4096, ff.ActiMode.AC_MODE_RELU)
+    x = model.dense(x, 10)
+    return model.softmax(x)
+
+
+def top_level_task(n_samples=64):
+    config = ff.FFConfig.from_args()
+    config.batch_size = min(config.batch_size, n_samples)
+    model = ff.FFModel(config)
+    t = model.create_tensor([config.batch_size, 3, 229, 229],
+                            ff.DataType.DT_FLOAT)
+    build_alexnet(model, t)
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=config.learning_rate),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY])
+
+    rng = np.random.RandomState(config.seed)
+    # zero-mean input (the usual mean-subtracted image preprocessing):
+    # without it the positive mean amplifies through the un-normalized
+    # relu conv stack and saturates the softmax
+    xs = rng.randn(n_samples, 3, 229, 229).astype(np.float32)
+    ys = rng.randint(0, 10, size=(n_samples, 1)).astype(np.int32)
+    model.fit(xs, ys, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,58 @@
+"""BERT-proxy (reference examples/python/native/bert_proxy_native.py):
+a stack of transformer encoder layers at BERT-base-ish ratios, scaled down
+by default so it runs anywhere; pass --layers/--hidden to scale up.
+
+Run: python examples/python/native/bert_proxy_native.py [-b 8]
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import argparse
+import sys
+
+import numpy as np
+
+import flexflow_tpu as ff
+
+
+def top_level_task():
+    p = argparse.ArgumentParser(add_help=False)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--hidden", type=int, default=128)
+    p.add_argument("--seq", type=int, default=64)
+    args, rest = p.parse_known_args()
+    config = ff.FFConfig.from_args(rest)
+    model = ff.FFModel(config)
+
+    H, S, L = args.hidden, args.seq, args.layers
+    heads = max(1, H // 64)
+    tokens = model.create_tensor([config.batch_size, S],
+                                 ff.DataType.DT_INT32)
+    x = model.embedding(tokens, 1000, H)
+    for _ in range(L):
+        a = model.multihead_attention(x, x, x, embed_dim=H, num_heads=heads)
+        x = model.layer_norm(model.add(a, x), axes=[-1])
+        h = model.dense(x, 4 * H, ff.ActiMode.AC_MODE_GELU)
+        h = model.dense(h, H)
+        x = model.layer_norm(model.add(h, x), axes=[-1])
+    x = model.mean(x, dims=[1])
+    x = model.dense(x, 2)
+    model.softmax(x)
+
+    model.compile(
+        optimizer=ff.AdamOptimizer(model, alpha=1e-4),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY])
+
+    rng = np.random.RandomState(config.seed)
+    xs = rng.randint(0, 1000, size=(256, S)).astype(np.int32)
+    ys = (xs[:, 0] % 2).reshape(-1, 1).astype(np.int32)
+    model.fit(xs, ys, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

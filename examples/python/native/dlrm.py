@@ -1,0 +1,69 @@
+"""DLRM recommendation model (reference examples/cpp/DLRM/dlrm.cc:30
+top_level_task, python twin examples/python/native/dlrm.py): sparse
+embeddings + bottom/top MLPs with feature interaction by concat.
+
+Run: python examples/python/native/dlrm.py [-b 64] [-e 1]
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu as ff
+
+NUM_SPARSE = 4          # sparse feature fields
+VOCAB = 1000            # per-field vocabulary
+EMB_DIM = 16
+DENSE_IN = 13           # dense feature count (criteo-style)
+
+
+def mlp(model, x, dims, final_act=None):
+    for i, d in enumerate(dims):
+        act = (ff.ActiMode.AC_MODE_RELU if i < len(dims) - 1 or final_act
+               else ff.ActiMode.AC_MODE_NONE)
+        x = model.dense(x, d, act)
+    return x
+
+
+def top_level_task():
+    config = ff.FFConfig.from_args()
+    model = ff.FFModel(config)
+    B = config.batch_size
+
+    dense_in = model.create_tensor([B, DENSE_IN], ff.DataType.DT_FLOAT)
+    sparse_ins = [model.create_tensor([B, 1], ff.DataType.DT_INT32)
+                  for _ in range(NUM_SPARSE)]
+
+    bottom = mlp(model, dense_in, [64, EMB_DIM], final_act=True)
+    embs = []
+    for s in sparse_ins:
+        e = model.embedding(s, VOCAB, EMB_DIM,
+                            aggr=ff.AggrMode.AGGR_MODE_SUM)
+        embs.append(model.reshape(e, [B, EMB_DIM]))
+    # interaction: concat embeddings + bottom-MLP output (interact_features
+    # "cat", dlrm.cc:77)
+    z = model.concat(embs + [bottom], axis=1)
+    out = mlp(model, z, [64, 32, 1])
+    out = model.sigmoid(out)
+
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=config.learning_rate),
+        loss_type=ff.LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[ff.MetricsType.METRICS_MEAN_SQUARED_ERROR])
+
+    rng = np.random.RandomState(config.seed)
+    n = 1024
+    dense = rng.rand(n, DENSE_IN).astype(np.float32)
+    sparse = [rng.randint(0, VOCAB, size=(n, 1)).astype(np.int32)
+              for _ in range(NUM_SPARSE)]
+    w = rng.rand(DENSE_IN) - 0.5
+    labels = (dense @ w > 0).astype(np.float32).reshape(-1, 1)
+    model.fit([dense] + sparse, labels, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

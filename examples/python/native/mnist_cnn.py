@@ -1,0 +1,45 @@
+"""MNIST CNN (reference examples/python/native/mnist_cnn.py): two conv
+blocks + dense head, NCHW.
+
+Run: python examples/python/native/mnist_cnn.py [-b 64] [-e 2]
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.keras.datasets import mnist
+
+
+def top_level_task():
+    config = ff.FFConfig.from_args()
+    model = ff.FFModel(config)
+
+    t = model.create_tensor([config.batch_size, 1, 28, 28],
+                            ff.DataType.DT_FLOAT)
+    x = model.conv2d(t, 32, 3, 3, 1, 1, 1, 1, ff.ActiMode.AC_MODE_RELU)
+    x = model.conv2d(x, 64, 3, 3, 1, 1, 1, 1, ff.ActiMode.AC_MODE_RELU)
+    x = model.pool2d(x, 2, 2, 2, 2, 0, 0)
+    x = model.flat(x)
+    x = model.dense(x, 128, ff.ActiMode.AC_MODE_RELU)
+    x = model.dense(x, 10)
+    model.softmax(x)
+
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=config.learning_rate),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY])
+
+    (x_train, y_train), _ = mnist.load_data(n_train=2048)
+    x_train = x_train.reshape(-1, 1, 28, 28).astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+    model.fit(x_train, y_train, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,46 @@
+"""MNIST MLP — the reference's PR1 config (scripts/mnist_mlp_run.sh,
+examples/python/native/mnist_mlp.py): 784-512-512-10 with SGD.
+
+Run: python examples/python/native/mnist_mlp.py [-b 64] [-e 2]
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.keras.datasets import mnist
+
+
+def top_level_task():
+    config = ff.FFConfig.from_args()
+    model = ff.FFModel(config)
+
+    t = model.create_tensor([config.batch_size, 784], ff.DataType.DT_FLOAT)
+    x = model.dense(t, 512, ff.ActiMode.AC_MODE_RELU)
+    x = model.dense(x, 512, ff.ActiMode.AC_MODE_RELU)
+    x = model.dense(x, 10)
+    model.softmax(x)
+
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=config.learning_rate),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY,
+                 ff.MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY])
+
+    (x_train, y_train), (x_test, y_test) = mnist.load_data()
+    x_train = x_train.reshape(-1, 784).astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+    x_test = x_test.reshape(-1, 784).astype(np.float32) / 255.0
+    y_test = y_test.reshape(-1, 1).astype(np.int32)
+
+    model.fit(x_train, y_train, epochs=config.epochs)
+    print("test:", model.evaluate(x_test, y_test))
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,41 @@
+"""Mixture-of-experts classifier (reference examples/cpp/mixture_of_experts/
+moe.cc:148: FFModel::moe composite = gate topk + group_by + experts +
+aggregate).
+
+Run: python examples/python/native/moe.py [-b 32] [-e 2]
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu as ff
+
+
+def top_level_task():
+    config = ff.FFConfig.from_args()
+    model = ff.FFModel(config)
+    t = model.create_tensor([config.batch_size, 64], ff.DataType.DT_FLOAT)
+    x = model.dense(t, 64, ff.ActiMode.AC_MODE_RELU)
+    x = model.moe(x, num_exp=4, num_select=2, expert_hidden_size=64)
+    x = model.dense(x, 10)
+    model.softmax(x)
+
+    model.compile(
+        optimizer=ff.AdamOptimizer(model, alpha=1e-3),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY])
+
+    rng = np.random.RandomState(config.seed)
+    w = rng.randn(64, 10)
+    xs = rng.randn(1024, 64).astype(np.float32)
+    ys = np.argmax(xs @ w, axis=1).reshape(-1, 1).astype(np.int32)
+    model.fit(xs, ys, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,62 @@
+"""ResNet-style CIFAR trainer (reference examples/cpp/ResNet/resnet.cc):
+basic residual blocks with identity shortcuts via the add op.
+
+Run: python examples/python/native/resnet.py [-b 32] [-e 1]
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.keras.datasets import cifar10
+
+
+def basic_block(model, x, channels, stride):
+    """conv-bn-relu -> conv-bn + shortcut (reference BottleneckBlock,
+    resnet.cc:39 — batch norm after every conv keeps the residual stack
+    stable, same as the reference)."""
+    shortcut = x
+    y = model.conv2d(x, channels, 3, 3, stride, stride, 1, 1)
+    y = model.batch_norm(y, relu=True)
+    y = model.conv2d(y, channels, 3, 3, 1, 1, 1, 1)
+    y = model.batch_norm(y, relu=False)
+    if stride != 1 or x.dims[1] != channels:
+        shortcut = model.conv2d(x, channels, 1, 1, stride, stride, 0, 0)
+        shortcut = model.batch_norm(shortcut, relu=False)
+    out = model.add(y, shortcut)
+    return model.relu(out)
+
+
+def top_level_task():
+    config = ff.FFConfig.from_args()
+    model = ff.FFModel(config)
+    t = model.create_tensor([config.batch_size, 3, 32, 32],
+                            ff.DataType.DT_FLOAT)
+    x = model.conv2d(t, 16, 3, 3, 1, 1, 1, 1, ff.ActiMode.AC_MODE_RELU)
+    for channels, stride in [(16, 1), (16, 1), (32, 2), (32, 1),
+                             (64, 2), (64, 1)]:
+        x = basic_block(model, x, channels, stride)
+    x = model.pool2d(x, 8, 8, 1, 1, 0, 0, ff.PoolType.POOL_AVG)
+    x = model.flat(x)
+    x = model.dense(x, 10)
+    model.softmax(x)
+
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=config.learning_rate,
+                                  momentum=0.9),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY])
+
+    (x_train, y_train), _ = cifar10.load_data(n_train=1024)
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+    model.fit(x_train, y_train, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,58 @@
+"""Transformer encoder trainer (reference examples/cpp/Transformer/
+transformer.cc: stacked attention + FFN layers on sequence data).
+
+Run: python examples/python/native/transformer.py [-b 16] [-e 1]
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu as ff
+
+SEQ = 64
+DIM = 64
+HEADS = 4
+LAYERS = 2
+VOCAB = 200
+
+
+def encoder_layer(model, x):
+    attn = model.multihead_attention(x, x, x, embed_dim=DIM, num_heads=HEADS)
+    x = model.add(attn, x)
+    x = model.layer_norm(x, axes=[-1])
+    h = model.dense(x, 4 * DIM, ff.ActiMode.AC_MODE_RELU)
+    h = model.dense(h, DIM)
+    x = model.add(h, x)
+    return model.layer_norm(x, axes=[-1])
+
+
+def top_level_task():
+    config = ff.FFConfig.from_args()
+    model = ff.FFModel(config)
+    tokens = model.create_tensor([config.batch_size, SEQ],
+                                 ff.DataType.DT_INT32)
+    x = model.embedding(tokens, VOCAB, DIM)
+    for _ in range(LAYERS):
+        x = encoder_layer(model, x)
+    x = model.mean(x, dims=[1])            # pool over sequence
+    x = model.dense(x, 4)
+    model.softmax(x)
+
+    model.compile(
+        optimizer=ff.AdamOptimizer(model, alpha=1e-3),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY])
+
+    rng = np.random.RandomState(config.seed)
+    xs = rng.randint(0, VOCAB, size=(512, SEQ)).astype(np.int32)
+    ys = (xs.sum(axis=1) % 4).reshape(-1, 1).astype(np.int32)
+    model.fit(xs, ys, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

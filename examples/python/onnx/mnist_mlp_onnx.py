@@ -1,0 +1,56 @@
+"""ONNX-frontend MNIST MLP (reference examples/python/onnx/mnist_mlp.py):
+synthesize an ONNX model with the built-in codec, import and train it."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.keras.datasets import mnist
+from flexflow_tpu.onnx import ONNXModel
+from flexflow_tpu.onnx import proto as P
+
+
+def make_onnx_mlp(rng):
+    w1 = (rng.randn(784, 512) * 0.05).astype(np.float32)
+    b1 = np.zeros(512, np.float32)
+    w2 = (rng.randn(512, 10) * 0.05).astype(np.float32)
+    b2 = np.zeros(10, np.float32)
+    nodes = [
+        P.encode_node("Gemm", ["x", "w1", "b1"], ["h"], name="fc1", transB=0),
+        P.encode_node("Relu", ["h"], ["hr"], name="relu1"),
+        P.encode_node("Gemm", ["hr", "w2", "b2"], ["o"], name="fc2", transB=0),
+        P.encode_node("Softmax", ["o"], ["y"], name="sm", axis=-1),
+    ]
+    return P.encode_model(
+        nodes,
+        inputs=[P.encode_value_info("x", [64, 784])],
+        outputs=[P.encode_value_info("y", [64, 10])],
+        initializers={"w1": w1, "b1": b1, "w2": w2, "b2": b2})
+
+
+def top_level_task():
+    config = ff.FFConfig.from_args()
+    rng = np.random.RandomState(config.seed)
+    model = ff.FFModel(config)
+    t = model.create_tensor([config.batch_size, 784], ff.DataType.DT_FLOAT)
+    om = ONNXModel(make_onnx_mlp(rng))
+    om.apply(model, {"x": t})
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=config.learning_rate),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY])
+    om.import_initializers(model)
+
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 784).astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+    model.fit(x_train, y_train, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,52 @@
+"""PyTorch-frontend MNIST MLP (reference examples/python/pytorch/
+mnist_mlp_torch.py): define the model in torch, fx-trace it into the
+framework, train on TPU."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+import torch.nn as nn
+
+import flexflow_tpu as ff
+from flexflow_tpu.keras.datasets import mnist
+from flexflow_tpu.torch import PyTorchModel
+
+
+class MLP(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(784, 512)
+        self.r1 = nn.ReLU()
+        self.fc2 = nn.Linear(512, 512)
+        self.r2 = nn.ReLU()
+        self.fc3 = nn.Linear(512, 10)
+        self.sm = nn.Softmax(dim=-1)
+
+    def forward(self, x):
+        return self.sm(self.fc3(self.r2(self.fc2(self.r1(self.fc1(x))))))
+
+
+def top_level_task():
+    config = ff.FFConfig.from_args()
+    model = ff.FFModel(config)
+    t = model.create_tensor([config.batch_size, 784], ff.DataType.DT_FLOAT)
+    pt = PyTorchModel(MLP())
+    pt.torch_to_ff(model, [t])
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=config.learning_rate),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY])
+    pt.copy_weights(model)   # start from the torch init
+
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 784).astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+    model.fit(x_train, y_train, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

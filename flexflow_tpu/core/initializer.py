@@ -23,7 +23,11 @@ class GlorotUniformInitializer(Initializer):
         self.seed = seed
 
     def __call__(self, key, shape, dtype):
-        if len(shape) >= 2:
+        if len(shape) == 4:
+            # conv kernel, OIHW layout: fans include the receptive field
+            receptive = shape[2] * shape[3]
+            fan_in, fan_out = shape[1] * receptive, shape[0] * receptive
+        elif len(shape) >= 2:
             fan_in, fan_out = shape[-2], shape[-1]
         else:
             fan_in = fan_out = shape[0] if shape else 1

@@ -68,7 +68,8 @@ def _declare(lib: ctypes.CDLL):
     lib.ffbpe_vocab_size.restype = c.c_int
     lib.ffbpe_vocab_size.argtypes = [c.c_void_p]
     lib.ffbpe_encode.restype = c.c_int
-    lib.ffbpe_encode.argtypes = [c.c_void_p, c.c_char_p, i32p, c.c_int]
+    lib.ffbpe_encode.argtypes = [c.c_void_p, c.c_char_p, c.c_int, i32p,
+                                 c.c_int]
     lib.ffbpe_decode.restype = c.c_int
     lib.ffbpe_decode.argtypes = [c.c_void_p, i32p, c.c_int, c.c_char_p,
                                  c.c_int]
@@ -116,12 +117,24 @@ def load_native() -> Optional[ctypes.CDLL]:
             return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
-            _declare(lib)
-            _lib = lib
-            return lib
         except Exception:
-            _build_failed = True
-            return None
+            # a stale/foreign-platform .so (equal checkout mtimes defeat
+            # _needs_build): rebuild from source once before giving up
+            try:
+                os.remove(_LIB_PATH)
+            except OSError:
+                pass
+            if not _build():
+                _build_failed = True
+                return None
+            try:
+                lib = ctypes.CDLL(_LIB_PATH)
+            except Exception:
+                _build_failed = True
+                return None
+        _declare(lib)
+        _lib = lib
+        return lib
 
 
 def native_available() -> bool:

@@ -214,7 +214,7 @@ class BPETokenizer:
         cap = max(64, 2 * len(data))
         while True:
             buf = (ctypes.c_int32 * cap)()
-            n = self._lib.ffbpe_encode(self._h, data, buf, cap)
+            n = self._lib.ffbpe_encode(self._h, data, len(data), buf, cap)
             if n >= 0:
                 return list(buf[:n])
             cap = -n

@@ -156,8 +156,11 @@ class ONNXModel:
             t = ff.concat(ins, int(_attr(node, "axis", 0)), name=name)
         elif op == "Split":
             sizes = _attr(node, "split")
-            if sizes is None and len(node.inputs) > 1:
+            if sizes is None and len(node.inputs) > 1 and node.inputs[1]:
                 # opset >= 13 carries split sizes as a second input
+                if node.inputs[1] not in init:
+                    raise NotImplementedError(
+                        "Split with dynamic (non-initializer) sizes")
                 sizes = [int(s) for s in init[node.inputs[1]]]
             axis = int(_attr(node, "axis", 0))
             if sizes is None:     # equal split over the declared outputs

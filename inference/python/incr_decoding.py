@@ -1,0 +1,64 @@
+"""Incremental-decoding serving entry (reference inference/python/
+incr_decoding.py, C++ main inference/incr_decoding/incr_decoding.cc:118).
+
+With network access / a local checkpoint directory:
+    python inference/python/incr_decoding.py --model <hf-dir> \
+        --prompt "Hello" --max-new-tokens 64
+Without (zero-egress default), serves a randomly-initialized LLaMA-class
+model to exercise the full serving stack.
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import argparse
+
+import flexflow_tpu.serve as ff_serve
+
+
+def make_model(path):
+    if path:
+        return path
+    import torch
+    import transformers
+
+    torch.manual_seed(0)
+    return transformers.LlamaForCausalLM(transformers.LlamaConfig(
+        vocab_size=1024, hidden_size=256, intermediate_size=688,
+        num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=4,
+        max_position_embeddings=512, tie_word_embeddings=False))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="", help="HF checkpoint dir (optional)")
+    p.add_argument("--prompt", action="append", default=None)
+    p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--max-requests-per-batch", type=int, default=4)
+    p.add_argument("--max-seq-length", type=int, default=256)
+    p.add_argument("--max-tokens-per-batch", type=int, default=64)
+    p.add_argument("--output-file", default="")
+    args = p.parse_args()
+
+    ff_serve.init()
+    llm = ff_serve.LLM(make_model(args.model), output_file=args.output_file)
+    llm.compile(max_requests_per_batch=args.max_requests_per_batch,
+                max_seq_length=args.max_seq_length,
+                max_tokens_per_batch=args.max_tokens_per_batch)
+
+    prompts = args.prompt
+    if not prompts:
+        # token prompts when no tokenizer is available (random-init model)
+        prompts = [[1, 5, 9, 23], [1, 44, 17], [1, 3, 3, 7, 11]] \
+            if llm.tokenizer is None else ["Hello, my name is"]
+    results = llm.generate(prompts, max_new_tokens=args.max_new_tokens)
+    for r in results:
+        print(f"guid={r.guid} output_tokens={r.output_tokens} "
+              f"text={r.output_text!r}")
+
+
+if __name__ == "__main__":
+    main()

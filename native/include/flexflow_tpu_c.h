@@ -30,10 +30,11 @@ void ffbpe_destroy(void *handle);
 
 int ffbpe_vocab_size(void *handle);
 
-/* Encode UTF-8 text into ids. Returns the number of ids produced, or a
- * negative value whose magnitude is the required capacity if cap is too
- * small. */
-int ffbpe_encode(void *handle, const char *text, int32_t *out_ids, int cap);
+/* Encode UTF-8 text (explicit length — embedded NULs are data, not
+ * terminators) into ids. Returns the number of ids produced, or a negative
+ * value whose magnitude is the required capacity if cap is too small. */
+int ffbpe_encode(void *handle, const char *text, int text_len,
+                 int32_t *out_ids, int cap);
 
 /* Decode ids to UTF-8. Returns bytes written (excluding NUL), or negative
  * required capacity. */

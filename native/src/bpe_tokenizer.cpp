@@ -432,8 +432,10 @@ int ffbpe_vocab_size(void *handle) {
   return (int)static_cast<BPETokenizer *>(handle)->vocab.size();
 }
 
-int ffbpe_encode(void *handle, const char *text, int32_t *out_ids, int cap) {
-  auto ids = static_cast<BPETokenizer *>(handle)->encode(text);
+int ffbpe_encode(void *handle, const char *text, int text_len,
+                 int32_t *out_ids, int cap) {
+  auto ids = static_cast<BPETokenizer *>(handle)->encode(
+      std::string(text, (size_t)text_len));
   if ((int)ids.size() > cap) return -(int)ids.size();
   memcpy(out_ids, ids.data(), ids.size() * sizeof(int32_t));
   return (int)ids.size();

@@ -1,0 +1,23 @@
+"""Smoke tests for the example scripts (reference CI runs the example
+matrix in tests/multi_gpu_tests.sh; conv-heavy examples are exercised on
+the real chip, not in this CPU suite)."""
+
+import runpy
+import sys
+
+import pytest
+
+EXAMPLES = [
+    "examples/python/native/mnist_mlp.py",
+    "examples/python/native/moe.py",
+    "examples/python/native/dlrm.py",
+    "examples/python/onnx/mnist_mlp_onnx.py",
+    "examples/python/pytorch/mnist_mlp_torch.py",
+    "examples/python/keras/seq_mnist_mlp.py",
+]
+
+
+@pytest.mark.parametrize("script", EXAMPLES)
+def test_example_runs(script, monkeypatch):
+    monkeypatch.setattr(sys, "argv", [script, "-e", "1", "-b", "64"])
+    runpy.run_path(script, run_name="__main__")
