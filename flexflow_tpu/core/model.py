@@ -569,11 +569,17 @@ class FFModel:
         values: Dict[int, Any] = dict(feeds)
         ctx.state_in = state or {}
         ctx.state_out = {}
+        from flexflow_tpu.quant import dequantize_layer_params
+
         for layer in self.layers:
             impl = get_op_impl(layer.op_type)
             ins = [values[t.tensor_id] for t in layer.inputs]
             ctx.layer_name = layer.name
-            outs = impl.forward(layer.attrs, params.get(layer.name, {}), ins, ctx)
+            # int8/int4 weights dequantize lazily here, inside the jitted
+            # step, so HBM holds (and streams) the compressed form
+            lp = dequantize_layer_params(params.get(layer.name, {}),
+                                         ctx.compute_dtype)
+            outs = impl.forward(layer.attrs, lp, ins, ctx)
             if self.strategy is not None and self.policy is not None:
                 strat_op = self.strategy.ops.get(layer.name)
                 if strat_op is not None and outs:
@@ -898,11 +904,43 @@ class FFModel:
 
     def get_parameter_by_key(self, key: Tuple[str, str]) -> np.ndarray:
         layer_name, weight_name = key
-        return np.asarray(self.params[layer_name][weight_name])
+        from flexflow_tpu.quant import dequantize_array, is_quantized
+
+        leaf = self.params[layer_name][weight_name]
+        if is_quantized(leaf):
+            return np.asarray(dequantize_array(leaf))
+        return np.asarray(leaf)
+
+    def quantize_weights(self, qtype: str):
+        """Compress eligible weights to int8/int4 on device (reference
+        4/8-bit weight quantization, config.h:161-163; compute path in
+        flexflow_tpu/quant.py). Inference-only: quantized params are not
+        trainable."""
+        from flexflow_tpu.quant import quantize_params, quantized_nbytes
+
+        if self.optimizer is not None:
+            raise RuntimeError(
+                "quantize_weights is inference-only: int8/int4 params are "
+                "not differentiable — compile without an optimizer")
+        before = quantized_nbytes(self.params)
+        self.params = quantize_params(self.params, qtype)
+        after = quantized_nbytes(self.params)
+        if self.config.profiling:
+            print(f"quantize_weights({qtype}): {before / 1e6:.1f}MB -> "
+                  f"{after / 1e6:.1f}MB")
+        return self
 
     def set_parameter_by_key(self, key: Tuple[str, str], value: np.ndarray):
         layer_name, weight_name = key
+        from flexflow_tpu.quant import is_quantized, quantize_array
+
         old = self.params[layer_name][weight_name]
+        if is_quantized(old):   # writes to a quantized weight re-quantize
+            arr = jnp.asarray(value, dtype=jnp.dtype(old.dtype))
+            assert arr.shape == old.shape, (arr.shape, old.shape)
+            self.params[layer_name][weight_name] = quantize_array(
+                arr, old.qtype)
+            return
         arr = jnp.asarray(value, dtype=old.dtype)
         assert arr.shape == old.shape, (arr.shape, old.shape)
         self.params[layer_name][weight_name] = jax.device_put(arr, old.sharding)

@@ -1,0 +1,134 @@
+"""Weight-only int8/int4 quantization for serving.
+
+Capability parity with the reference's 4/8-bit weight compression
+(src/ops/kernels/decompress_kernels.cu, inference/utils/
+compress_llama_weights.py, flags config.h:161-163). TPU-idiomatic design:
+weights are stored on device as int8 (int4 packs two nibbles per byte) with
+a per-output-channel float scale; the jitted step dequantizes on the fly so
+the HBM read of each weight is 1/4 or 1/8 the bytes — on
+bandwidth-bound decode steps that is the win; XLA fuses the dequant
+multiply into the consumer.
+
+Symmetric per-column scheme (the reference's decompress path is also
+scale-only): q = round(w / s), s = max|w_col| / qmax.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedWeight:
+    """Pytree leaf-pair: int8 payload + per-column scale, with static
+    metadata (qtype, original rows, original dtype) so it passes through
+    jit boundaries."""
+
+    def __init__(self, qtype: str, q, scale, rows: int, dtype: str):
+        self.qtype = qtype
+        self.q = q
+        self.scale = scale
+        self.rows = rows
+        self.dtype = dtype
+
+    def tree_flatten(self):
+        return (self.q, self.scale), (self.qtype, self.rows, self.dtype)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(aux[0], children[0], children[1], aux[1], aux[2])
+
+    @property
+    def nbytes(self) -> int:
+        return getattr(self.q, "nbytes", 0) + getattr(self.scale, "nbytes", 0)
+
+    @property
+    def shape(self):
+        return (self.rows, self.q.shape[1])
+
+    def __repr__(self):
+        return (f"QuantizedWeight({self.qtype}, shape={self.shape}, "
+                f"dtype={self.dtype})")
+
+
+def quantize_array(w, qtype: str) -> QuantizedWeight:
+    """Quantize a 2-D float array (int4 packs two rows per byte)."""
+    w = jnp.asarray(w)
+    assert w.ndim == 2, w.shape
+    qmax = 127.0 if qtype == "int8" else 7.0
+    scale = jnp.max(jnp.abs(w), axis=0) / qmax            # [out]
+    scale = jnp.where(scale == 0, 1.0, scale).astype(jnp.float32)
+    q = jnp.clip(jnp.round(w / scale[None, :]), -qmax, qmax).astype(jnp.int8)
+    rows = int(w.shape[0])
+    if qtype == "int4":
+        if q.shape[0] % 2:
+            q = jnp.pad(q, ((0, 1), (0, 0)))
+        lo = q[0::2] & 0x0F
+        hi = (q[1::2] & 0x0F) << 4
+        q = (lo | hi).astype(jnp.int8)                    # [ceil(in/2), out]
+    return QuantizedWeight(qtype, q, scale, rows, str(w.dtype))
+
+
+def _unpack_int4(q, rows: int):
+    lo = (q << 4).astype(jnp.int8) >> 4                   # sign-extend nibble
+    hi = q >> 4                                           # arithmetic shift
+    full = jnp.stack([lo, hi], axis=1).reshape(-1, q.shape[1])
+    return full[:rows]
+
+
+def dequantize_array(leaf: QuantizedWeight, dtype=None):
+    q = leaf.q
+    if leaf.qtype == "int4":
+        q = _unpack_int4(q, leaf.rows)
+    out_dtype = dtype or jnp.dtype(leaf.dtype)
+    return (q.astype(jnp.float32) * leaf.scale[None, :]).astype(out_dtype)
+
+
+def is_quantized(leaf) -> bool:
+    return isinstance(leaf, QuantizedWeight)
+
+
+# weights eligible for quantization: the serving matmul weights
+_QUANT_NAMES = {"kernel", "wq", "wk", "wv", "wo", "weight",
+                "w1", "w2", "w3", "gate", "up", "down"}
+
+
+def quantize_params(params: Dict[str, Dict[str, Any]], qtype: str,
+                    min_dim: int = 64) -> Dict[str, Dict[str, Any]]:
+    """Quantize every eligible 2-D weight in a model params tree."""
+    assert qtype in ("int8", "int4"), qtype
+    out: Dict[str, Dict[str, Any]] = {}
+    for layer, ws in params.items():
+        new_ws = {}
+        for name, w in ws.items():
+            arr = jnp.asarray(w) if not is_quantized(w) else None
+            if (arr is not None and name in _QUANT_NAMES and arr.ndim == 2
+                    and min(arr.shape) >= min_dim
+                    and jnp.issubdtype(arr.dtype, jnp.floating)):
+                new_ws[name] = quantize_array(arr, qtype)
+            else:
+                new_ws[name] = w
+        out[layer] = new_ws
+    return out
+
+
+def dequantize_layer_params(ws: Optional[Dict[str, Any]], dtype=None):
+    """Lazily dequantize one layer's weights (called inside the jitted
+    step; XLA fuses the scale-multiply into the consumer matmul)."""
+    if not ws:
+        return ws
+    if not any(is_quantized(v) for v in ws.values()):
+        return ws
+    return {k: dequantize_array(v, dtype) if is_quantized(v) else v
+            for k, v in ws.items()}
+
+
+def quantized_nbytes(params) -> int:
+    """Device bytes of the (possibly quantized) params tree."""
+    total = 0
+    for leaf in jax.tree.leaves(params):
+        total += getattr(leaf, "nbytes", 0)
+    return total

@@ -165,6 +165,10 @@ class LLM:
         # weights now live on device with their shardings; drop the host
         # copy so a 7B checkpoint doesn't stay resident twice
         self._state_dict = None
+        if config.quantization_type:
+            # 4/8-bit weight-only compression (reference --4bit/--8bit-
+            # quantization flags): done post-load so scales see real weights
+            self.ffmodel.quantize_weights(config.quantization_type)
 
         self.rm = RequestManager()
         if self.tokenizer is not None:

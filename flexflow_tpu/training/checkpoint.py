@@ -141,9 +141,13 @@ class CheckpointManager:
 # name mapping (models/__init__.py) without orbax metadata.
 # ----------------------------------------------------------------------
 def save_weights_npz(path: str, model) -> None:
+    from flexflow_tpu.quant import dequantize_array, is_quantized
+
     flat = {}
     for lname, lp in model.params.items():
         for wname, w in lp.items():
+            if is_quantized(w):   # export at full precision
+                w = dequantize_array(w)
             flat[f"{lname}.{wname}"] = np.asarray(w)
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     np.savez(path, **flat)
