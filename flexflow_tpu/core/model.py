@@ -67,6 +67,8 @@ class FFModel:
         self._train_step = None
         self._eval_step = None
         self._perf = PerfMetrics()
+        from flexflow_tpu.utils.profiling import StepTimer
+        self._step_timer = StepTimer(enabled=True)
         self._rng = jax.random.PRNGKey(self.config.seed)
         self._cached_activations = None
         self._cached_grads = None
@@ -622,6 +624,15 @@ class FFModel:
             self.strategy = optimize_model(
                 self, chip=self.config.tpu_chip,
                 training=(comp_mode == CompMode.COMP_MODE_TRAINING))
+        if self.config.export_strategy_file:
+            # dot export of the (searched) computation graph (reference
+            # --export-strategy-computation-graph-file, model.cc:4218)
+            from flexflow_tpu.utils.dot import export_model_dot
+
+            export_model_dot(
+                self, self.config.export_strategy_file,
+                include_costs=self.config.include_costs_dot_graph,
+                strategy=self.strategy)
 
         # --- parameter + op-state init ---
         key = jax.random.PRNGKey(self.config.seed)
@@ -784,9 +795,17 @@ class FFModel:
         label = jnp.asarray(y, dtype=self.label_tensor.dtype.to_jnp())
         if self.policy is not None:
             label = jax.device_put(label, self.policy.batch_sharding(label.shape))
+        import time as _time
+
+        t0 = _time.perf_counter() if self.config.profiling else 0.0
         (self.params, self.opt_state, self.op_state, loss,
          step_metrics) = self._train_step(self.params, self.opt_state,
                                           self.op_state, feeds, label, step_rng)
+        if self.config.profiling:
+            # --profiling parity: per-step device-fenced timing print
+            jax.block_until_ready(loss)
+            self._step_timer.record("train_step",
+                                    _time.perf_counter() - t0)
         bs = y.shape[0]
         self._perf.update({k: float(v) for k, v in step_metrics.items()}, bs)
         return float(loss)
@@ -820,7 +839,9 @@ class FFModel:
             history.append({"epoch": epoch, "loss": float(np.mean(losses)),
                             **self._metrics_summary()})
             print(f"epoch {epoch}: loss={history[-1]['loss']:.4f} "
-                  f"{self._perf.report()}")
+                  f"{self._perf.report()}"
+                  + (f" [{self._step_timer.report()}]"
+                     if self.config.profiling else ""))
         return history
 
     def evaluate(self, x=None, y=None, batch_size: Optional[int] = None):
@@ -938,12 +959,30 @@ class FFModel:
         if is_quantized(old):   # writes to a quantized weight re-quantize
             arr = jnp.asarray(value, dtype=jnp.dtype(old.dtype))
             assert arr.shape == old.shape, (arr.shape, old.shape)
-            self.params[layer_name][weight_name] = quantize_array(
-                arr, old.qtype)
+            new = quantize_array(arr, old.qtype)
+            # keep the load-time shardings of the payload/scale
+            new.q = jax.device_put(new.q, old.q.sharding)
+            new.scale = jax.device_put(new.scale, old.scale.sharding)
+            self.params[layer_name][weight_name] = new
             return
         arr = jnp.asarray(value, dtype=old.dtype)
         assert arr.shape == old.shape, (arr.shape, old.shape)
         self.params[layer_name][weight_name] = jax.device_put(arr, old.sharding)
+
+    def export_dot(self, path: str, include_costs: bool = False,
+                   costs=None) -> str:
+        """Graphviz export of the computation graph (reference
+        export_strategy_computation_graph_file)."""
+        from flexflow_tpu.utils.dot import export_model_dot
+
+        return export_model_dot(self, path, include_costs=include_costs,
+                                costs=costs, strategy=self.strategy)
+
+    def recompile_on_condition(self, recompile_state) -> bool:
+        """Dynamic recompilation hook (reference model.cc:2791)."""
+        from flexflow_tpu.core.recompile import recompile_on_condition
+
+        return recompile_on_condition(self, recompile_state)
 
     def get_layers(self) -> Dict[int, Layer]:
         return dict(enumerate(self.layers))

@@ -1,0 +1,53 @@
+"""Dynamic recompilation hook.
+
+Capability parity with the reference RecompileState
+(include/flexflow/recompile.h, src/recompile/recompile_state.cc,
+FFModel::recompile_on_condition model.cc:2791): a user ``trigger_func``
+is evaluated once per training iteration; when it fires, ``alter_func``
+mutates the model (e.g. MoE capacity factor in the moe example) and the
+jitted step functions are rebuilt. Parameters whose (layer, name, shape)
+survive the alteration are preserved across the recompile.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+class RecompileState:
+    def __init__(self, trigger_func: Callable[[], bool],
+                 alter_func: Callable[["RecompileState"], None],
+                 ffmodel):
+        self.trigger_func = trigger_func
+        self.alter_func = alter_func
+        self.ffmodel = ffmodel
+        self.recompilations = 0
+
+    def trigger(self) -> bool:
+        return bool(self.trigger_func())
+
+    def alter(self):
+        self.alter_func(self)
+        self.recompilations += 1
+
+
+def recompile_on_condition(model, rs: RecompileState) -> bool:
+    """Evaluate the trigger; on fire, run alter and rebuild the jitted
+    steps, carrying over matching parameters (reference model.cc:2791)."""
+    if not rs.trigger():
+        return False
+    old_params = model.params or {}
+    rs.alter()
+    # rebuild: recompile with the same optimizer/loss/metrics
+    model.compile(optimizer=model.optimizer, loss_type=model.loss_type,
+                  metrics=model.metrics)
+    for lname, ws in (model.params or {}).items():
+        old_ws = old_params.get(lname)
+        if not old_ws:
+            continue
+        for wname, w in ws.items():
+            old = old_ws.get(wname)
+            if old is not None and getattr(old, "shape", None) == w.shape \
+                    and getattr(old, "dtype", None) == w.dtype:
+                ws[wname] = old
+    return True

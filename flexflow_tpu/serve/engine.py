@@ -36,18 +36,25 @@ from flexflow_tpu.ops.base import OpContext
 from flexflow_tpu.serve.batch_config import BatchMeta
 
 
-def forward_with_meta(model, params, state, meta, rng, compute_dtype):
-    """One serving forward over a BatchMeta inside jit — the single traced
-    body shared by InferenceManager.step and the fused engines (one place to
-    maintain feed construction / position offsets)."""
-    ctx = OpContext(training=False, rng=rng, compute_dtype=compute_dtype,
-                    batch_config=meta, mesh=model.mesh, config=model.config)
+def build_feeds(model, meta):
+    """The ONE place feed construction / position offsets live — used by
+    the jitted serving body below and the eager debug-dump path
+    (utils/debugging.dump_serving_step)."""
     feeds = {model.input_tensors[0].tensor_id: meta.tokens}
     pos_t = getattr(model, "position_input_tensor", None)
     if pos_t is not None:
         feeds[pos_t.tensor_id] = (meta.positions
                                   + getattr(model, "position_offset", 0))
-    values, new_state = model._run_graph(params, feeds, ctx, state)
+    return feeds
+
+
+def forward_with_meta(model, params, state, meta, rng, compute_dtype):
+    """One serving forward over a BatchMeta inside jit — the single traced
+    body shared by InferenceManager.step and the fused engines."""
+    ctx = OpContext(training=False, rng=rng, compute_dtype=compute_dtype,
+                    batch_config=meta, mesh=model.mesh, config=model.config)
+    values, new_state = model._run_graph(params, build_feeds(model, meta),
+                                         ctx, state)
     return values[model._final_tensor.tensor_id], new_state
 
 

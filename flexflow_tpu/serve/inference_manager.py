@@ -33,6 +33,7 @@ class InferenceManager:
         self._step = jax.jit(self._step_impl, donate_argnums=(1,))
         self._rng = jax.random.PRNGKey(cfg.seed)
         self._decode_block = None
+        self._debug_step = 0
 
     def _step_impl(self, params, op_state, meta, rng):
         from flexflow_tpu.serve.engine import forward_with_meta
@@ -48,6 +49,15 @@ class InferenceManager:
         donated to the device program).
         """
         self._rng, step_rng = jax.random.split(self._rng)
+        if self.model.config.inference_debugging:
+            # reference inference_debugging mode: dump every op's
+            # inputs/weights/outputs for this step (operator.cc:29) before
+            # the jitted step consumes (donates) the current op_state
+            from flexflow_tpu.utils.debugging import dump_serving_step
+
+            dump_serving_step(self.model, meta, "./inference_tensors",
+                              self._debug_step, rng=step_rng)
+            self._debug_step += 1
         out, new_state = self._step(self.model.params, self.model.op_state,
                                     meta, step_rng)
         self.model.op_state = new_state
@@ -65,6 +75,11 @@ class InferenceManager:
         """
         from flexflow_tpu.serve.engine import make_decode_block
 
+        if self.model.config.inference_debugging:
+            # debug mode serializes decode into per-step step() calls so
+            # every decode token's op tensors are dumped (the fused
+            # while_loop body cannot host-dump); same numerics, slower.
+            return self._decode_block_debug(tok, pos, active, n_steps)
         if self._decode_block is None:
             self._decode_block = make_decode_block(
                 self.model, self._compute_dtype,
@@ -77,3 +92,23 @@ class InferenceManager:
             jnp.int32(n_steps))
         self.model.op_state = new_state
         return np.asarray(toks)[:, :n_steps]
+
+    def _decode_block_debug(self, tok, pos, active, n_steps: int):
+        from flexflow_tpu.serve.batch_config import BatchMeta
+
+        R = tok.shape[0]
+        cur = np.asarray(tok, np.int32).copy()
+        p = np.asarray(pos, np.int32).copy()
+        act = np.asarray(active, bool)
+        out = np.zeros((R, n_steps), np.int32)
+        for j in range(n_steps):
+            meta = BatchMeta(
+                tokens=cur.reshape(R, 1), positions=p.reshape(R, 1),
+                start_pos=p.copy(), num_tokens=act.astype(np.int32),
+                active=act)
+            step_out = self.step(meta)            # dumps + advances caches
+            nxt = np.asarray(step_out).reshape(R, -1)[:, 0].astype(np.int32)
+            out[:, j] = np.where(act, nxt, 0)
+            cur = np.where(act, nxt, cur)
+            p = p + act.astype(np.int32)
+        return out

@@ -1,0 +1,99 @@
+"""Inference tensor dumping for debugging/alignment.
+
+Capability parity with the reference's ``inference_debugging`` mode
+(Op::save_inference_tensors_to_file, src/runtime/operator.cc:29): every
+operator's inputs, weights, and outputs are written per step under
+``./inference_tensors`` so decoding steps can be diffed against another
+implementation (the alignment tests' mechanism, SURVEY §4).
+
+The jitted path never sees Python side effects, so dumping runs the graph
+eagerly layer-by-layer — same numerics, no jit — which is exactly what the
+reference does too (debug mode serializes execution).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def dump_forward(model, feeds: Dict[int, Any], out_dir: str,
+                 step: int = 0, state: Optional[Dict[str, Any]] = None,
+                 training: bool = False, batch_config=None,
+                 rng=None) -> Dict[int, Any]:
+    """Run the layer graph eagerly, dumping per-op npz files.
+
+    Layout: ``<out_dir>/step_<N>/<idx>_<layer>.npz`` with keys
+    ``input_<i>``, ``weight_<name>``, ``output_<i>``.
+    Returns the tensor-id -> value map (same as FFModel._run_graph).
+    """
+    from flexflow_tpu.ops.base import OpContext, get_op_impl
+    from flexflow_tpu.quant import dequantize_layer_params
+
+    step_dir = os.path.join(out_dir, f"step_{step}")
+    os.makedirs(step_dir, exist_ok=True)
+    ctx = OpContext(training=training, rng=rng,
+                    compute_dtype=jnp.dtype(model.config.compute_dtype),
+                    batch_config=batch_config, mesh=model.mesh)
+    ctx.config = model.config
+    ctx.state_in = state or model.op_state or {}
+    ctx.state_out = {}
+    values: Dict[int, Any] = dict(feeds)
+    for idx, layer in enumerate(model.layers):
+        impl = get_op_impl(layer.op_type)
+        ins = [values[t.tensor_id] for t in layer.inputs]
+        ctx.layer_name = layer.name
+        lp = dequantize_layer_params(model.params.get(layer.name, {}),
+                                     ctx.compute_dtype)
+        outs = impl.forward(layer.attrs, lp, ins, ctx)
+        for t, v in zip(layer.outputs, outs):
+            values[t.tensor_id] = v
+        blob = {}
+        for i, v in enumerate(ins):
+            blob[f"input_{i}"] = np.asarray(v)
+        for wname, w in (lp or {}).items():
+            blob[f"weight_{wname}"] = np.asarray(w)
+        for i, v in enumerate(outs):
+            blob[f"output_{i}"] = np.asarray(v)
+        np.savez(os.path.join(step_dir, f"{idx:03d}_{layer.name}.npz"),
+                 **blob)
+    return values
+
+
+def dump_serving_step(model, meta, out_dir: str, step: int, rng=None):
+    """Dump one serving step's per-op tensors (called by InferenceManager
+    when config.inference_debugging; reads op_state without mutating it)."""
+    import jax
+
+    from flexflow_tpu.serve.engine import build_feeds
+
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    dump_forward(model, build_feeds(model, meta), out_dir, step=step,
+                 state=model.op_state, batch_config=meta, rng=rng)
+
+
+def compare_dumps(dir_a: str, dir_b: str, rtol: float = 1e-4,
+                  atol: float = 1e-5):
+    """Diff two dump directories; returns list of (file, key, max_abs_err)
+    mismatches — the alignment-test oracle over dumps."""
+    mismatches = []
+    for fname in sorted(os.listdir(dir_a)):
+        pa, pb = os.path.join(dir_a, fname), os.path.join(dir_b, fname)
+        if not fname.endswith(".npz") or not os.path.exists(pb):
+            continue
+        with np.load(pa) as a, np.load(pb) as b:
+            for key in a.files:
+                if key not in b.files:
+                    mismatches.append((fname, key, float("inf")))
+                    continue
+                x, y = a[key], b[key]
+                if x.shape != y.shape or not np.allclose(
+                        x, y, rtol=rtol, atol=atol):
+                    err = (float(np.abs(x - y).max())
+                           if x.shape == y.shape else float("inf"))
+                    mismatches.append((fname, key, err))
+    return mismatches

@@ -1,0 +1,90 @@
+"""Graphviz (dot) export of the layer graph / parallel computation graph.
+
+Capability parity with the reference's dot tooling
+(src/utils/dot/record_formatter.cc, FFModel::export_strategy_computation_
+graph_file + --include-costs-dot-graph, model.cc:4218-4229): every operator
+becomes a record node showing its op type, output shape, and — when a
+search Strategy is attached or costs are provided — its sharding spec and
+estimated cost.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+
+def _esc(s: str) -> str:
+    return str(s).replace('"', r'\"').replace("{", r"\{").replace("}", r"\}") \
+        .replace("<", r"\<").replace(">", r"\>").replace("|", r"\|")
+
+
+def model_to_dot(model, include_costs: bool = False,
+                 costs: Optional[Dict[str, float]] = None,
+                 strategy=None) -> str:
+    """Render an FFModel's layer graph as a dot digraph string."""
+    if strategy is None:
+        strategy = getattr(model, "strategy", None)
+    lines = ["digraph taskgraph {",
+             '  node [shape=record, fontsize=10, fontname="helvetica"];']
+    tensor_producer = {}
+    for layer in model.layers:
+        for t in layer.outputs:
+            tensor_producer[t.tensor_id] = layer.name
+    for t in getattr(model, "input_tensors", []):
+        nid = f"input_{t.tensor_id}"
+        lines.append(f'  "{nid}" [label="{{input|{_esc(tuple(t.dims))}}}", '
+                     f"style=filled, fillcolor=lightgrey];")
+        tensor_producer[t.tensor_id] = nid
+    for layer in model.layers:
+        fields = [f"{_esc(layer.name)}",
+                  _esc(layer.op_type.name.lower()),
+                  _esc(tuple(layer.outputs[0].dims) if layer.outputs else ())]
+        if strategy is not None:
+            op = getattr(strategy, "ops", {}).get(layer.name)
+            if op is not None:
+                fields.append("spec: " + _esc(getattr(op, "output_spec",
+                                                      "")))
+        if include_costs and costs and layer.name in costs:
+            fields.append(f"cost: {costs[layer.name]:.3e}s")
+        label = "{" + "|".join(fields) + "}"
+        lines.append(f'  "{layer.name}" [label="{label}"];')
+        for t in layer.inputs:
+            src = tensor_producer.get(t.tensor_id)
+            if src is not None:
+                lines.append(f'  "{src}" -> "{layer.name}";')
+    lines.append("}")
+    return "\n".join(lines) + "\n"
+
+
+def export_model_dot(model, path: str, include_costs: bool = False,
+                     costs: Optional[Dict[str, float]] = None,
+                     strategy=None) -> str:
+    out = model_to_dot(model, include_costs=include_costs, costs=costs,
+                       strategy=strategy)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        f.write(out)
+    return path
+
+
+def pcg_to_dot(pcg, strategy=None, costs: Optional[Dict[str, float]] = None
+               ) -> str:
+    """Render a search PCG (flexflow_tpu.search.pcg.PCG) as dot."""
+    lines = ["digraph pcg {",
+             '  node [shape=record, fontsize=10, fontname="helvetica"];']
+    for node in pcg.nodes:
+        fields = [_esc(node.name), _esc(node.op_type.name.lower()),
+                  _esc(node.output_shapes[0] if node.output_shapes else ())]
+        if strategy is not None:
+            op = getattr(strategy, "ops", {}).get(node.name)
+            if op is not None:
+                fields.append("spec: " + _esc(getattr(op, "output_spec", "")))
+        if costs and node.name in costs:
+            fields.append(f"cost: {costs[node.name]:.3e}s")
+        lines.append(f'  "{node.name}" [label="{{{"|".join(fields)}}}"];')
+        for src in node.in_edges:
+            lines.append(f'  "{pcg.nodes[src].name}" -> "{node.name}";')
+    lines.append("}")
+    return "\n".join(lines) + "\n"

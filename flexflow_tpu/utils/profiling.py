@@ -1,0 +1,60 @@
+"""Profiling hooks.
+
+Capability parity with the reference's two profiling layers (SURVEY §5):
+(a) ``--profiling`` per-kernel cudaEvent timing prints → here per-step
+wall-time with ``block_until_ready`` fencing, and (b) Legion Prof traces →
+here the XLA/jax profiler (``jax.profiler.trace``) whose output loads in
+TensorBoard / Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+
+class StepTimer:
+    """Accumulates per-step device-fenced wall times (the --profiling
+    print path, reference linear_kernels.cu:159-225 style)."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self.times: Dict[str, List[float]] = {}
+
+    def record(self, name: str, seconds: float):
+        if self.enabled:
+            self.times.setdefault(name, []).append(seconds)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        out = {}
+        for name, ts in self.times.items():
+            out[name] = {"count": len(ts), "total_s": sum(ts),
+                         "mean_ms": 1e3 * sum(ts) / max(1, len(ts)),
+                         "last_ms": 1e3 * ts[-1]}
+        return out
+
+    def report(self) -> str:
+        return " ".join(f"{k}={v['mean_ms']:.2f}ms(x{v['count']})"
+                        for k, v in self.summary().items())
+
+
+@contextlib.contextmanager
+def profiler_trace(logdir: str):
+    """XLA device trace (the Legion Prof equivalent): view with
+    TensorBoard's profile plugin or Perfetto."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def timed_call(fn, *args, **kwargs):
+    """Run fn, block on its outputs, return (result, seconds)."""
+    t0 = time.perf_counter()
+    out = fn(*args, **kwargs)
+    jax.block_until_ready(out)
+    return out, time.perf_counter() - t0

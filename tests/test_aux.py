@@ -1,0 +1,202 @@
+"""Aux subsystem tests: dot export, profiling, inference-debug dumps,
+RecompileState, network simulator (SURVEY §5 parity)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+
+
+def _small_model(batch=16):
+    model = ff.FFModel(ff.FFConfig(batch_size=batch))
+    t = model.create_tensor([batch, 32], ff.DataType.DT_FLOAT)
+    x = model.dense(t, 32, ff.ActiMode.AC_MODE_RELU, name="fc1")
+    x = model.dense(x, 8, name="fc2")
+    model.softmax(x, name="sm")
+    return model
+
+
+def test_dot_export(tmp_path):
+    model = _small_model()
+    model.compile()
+    path = str(tmp_path / "graph.dot")
+    model.export_dot(path, include_costs=True, costs={"fc1": 1.5e-4})
+    text = open(path).read()
+    assert text.startswith("digraph")
+    assert "fc1" in text and "fc2" in text and "sm" in text
+    assert '"fc1" -> "fc2"' in text
+    assert "cost: 1.500e-04s" in text
+
+
+def test_export_strategy_file_on_compile(tmp_path):
+    path = str(tmp_path / "strategy.dot")
+    model = ff.FFModel(ff.FFConfig(batch_size=16,
+                                   export_strategy_file=path))
+    t = model.create_tensor([16, 32], ff.DataType.DT_FLOAT)
+    model.softmax(model.dense(t, 8))
+    model.compile()
+    assert os.path.exists(path)
+
+
+def test_pcg_dot():
+    from flexflow_tpu.search.pcg import PCG
+    from flexflow_tpu.utils.dot import pcg_to_dot
+
+    model = _small_model()
+    pcg = PCG.from_model(model)
+    text = pcg_to_dot(pcg)
+    assert "digraph pcg" in text and "fc1" in text
+
+
+def test_profiling_step_timer():
+    model = _small_model()
+    model.config.profiling = True
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.1),
+                  loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[ff.MetricsType.METRICS_ACCURACY])
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 32).astype(np.float32)
+    y = rng.randint(0, 8, (16, 1)).astype(np.int32)
+    model.train_one_batch([x], y)
+    model.train_one_batch([x], y)
+    s = model._step_timer.summary()
+    assert s["train_step"]["count"] == 2
+    assert s["train_step"]["mean_ms"] > 0
+
+
+def test_inference_debug_dumps(tmp_path, monkeypatch):
+    from flexflow_tpu.utils.debugging import compare_dumps, dump_forward
+
+    model = _small_model()
+    model.compile()
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 32).astype(np.float32)
+    feeds = {model.input_tensors[0].tensor_id: x}
+    d1 = str(tmp_path / "a")
+    d2 = str(tmp_path / "b")
+    vals = dump_forward(model, feeds, d1, step=0)
+    dump_forward(model, feeds, d2, step=0)
+    files = sorted(os.listdir(os.path.join(d1, "step_0")))
+    assert len(files) == 3  # fc1, fc2, sm
+    with np.load(os.path.join(d1, "step_0", files[0])) as blob:
+        assert "input_0" in blob and "weight_kernel" in blob \
+            and "output_0" in blob
+    assert compare_dumps(os.path.join(d1, "step_0"),
+                         os.path.join(d2, "step_0")) == []
+    # eager values match the jitted predict path
+    np.testing.assert_allclose(
+        np.asarray(vals[model._final_tensor.tensor_id]),
+        model.predict(x), rtol=1e-5, atol=1e-6)
+
+
+def test_serving_debug_dumps(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    from flexflow_tpu.ffconst import InferenceMode
+    from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+    from flexflow_tpu.serve.request_manager import RequestManager
+
+    cfg = ff.FFConfig(max_requests_per_batch=2, max_tokens_per_batch=16,
+                      max_sequence_length=32, inference_debugging=True,
+                      use_native_scheduler=False)
+    mcfg = LLAMAConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                       num_hidden_layers=1, num_attention_heads=2,
+                       num_key_value_heads=2, max_position_embeddings=32)
+    model = ff.FFModel(cfg)
+    create_llama_model(model, mcfg, InferenceMode.INC_DECODING_MODE)
+    model.compile()
+    rm = RequestManager(eos_token_id=None)
+    rm.register_new_request([3, 5, 7], max_new_tokens=2)
+    rm.generate_incr_decoding(model)
+    assert os.path.isdir("inference_tensors")
+    steps = os.listdir("inference_tensors")
+    assert steps, "no steps dumped"
+
+
+def test_recompile_state():
+    from flexflow_tpu.core.recompile import RecompileState
+
+    model = ff.FFModel(ff.FFConfig(batch_size=16))
+    t = model.create_tensor([16, 32], ff.DataType.DT_FLOAT)
+    x = model.dense(t, 32, ff.ActiMode.AC_MODE_RELU, name="fc1")
+    model.softmax(model.dense(x, 8, name="fc2"))
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.1),
+                  loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[])
+
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(16, 32).astype(np.float32)
+    y_np = rng.randint(0, 8, (16, 1)).astype(np.int32)
+    model.train_one_batch([x_np], y_np)
+    kernel_before = model.get_parameter_by_key(("fc1", "kernel"))
+
+    fired = {"n": 0}
+
+    def alter(rs):
+        fired["n"] += 1
+
+    rs = RecompileState(lambda: True, alter, model)
+    assert model.recompile_on_condition(rs)
+    assert fired["n"] == 1 and rs.recompilations == 1
+    # trained parameters survive the recompile
+    np.testing.assert_allclose(model.get_parameter_by_key(("fc1", "kernel")),
+                               kernel_before)
+    # model still trains after recompile
+    model.train_one_batch([x_np], y_np)
+
+    rs2 = RecompileState(lambda: False, alter, model)
+    assert not model.recompile_on_condition(rs2)
+    assert fired["n"] == 1
+
+
+def test_network_topologies_and_routing():
+    from flexflow_tpu.search.network import (
+        NetworkedMachineModel,
+        ShortestPathRouting,
+        big_switch_topology,
+        flat_degree_constrained_topology,
+        torus_topology,
+    )
+
+    # 2-D 4x4 torus: every node has 4 links, diameter 4
+    topo = torus_topology([4, 4], link_bandwidth=1e11)
+    assert topo.num_nodes == 16
+    assert all(topo.degree(i) == 4 for i in range(16))
+    routing = ShortestPathRouting(topo)
+    path = routing.route(0, 15)
+    assert path is not None and path[0] == 0 and path[-1] == 15
+    assert len(path) - 1 <= 4
+
+    # wrap-around makes 0 -> 12 one hop in a 4x4 torus (column wrap)
+    assert len(routing.route(0, 12)) == 2
+
+    # big switch: always 2 hops via the crossbar
+    bs = big_switch_topology(8, 1e10)
+    r2 = ShortestPathRouting(bs)
+    assert len(r2.route(0, 7)) == 3
+
+    # flat degree-constrained: connected, degree bounded
+    fd = flat_degree_constrained_topology(16, degree=4, link_bandwidth=1e10)
+    r3 = ShortestPathRouting(fd)
+    assert all(r3.route(0, i) is not None for i in range(16))
+
+    mm = NetworkedMachineModel(topo, hop_latency_s=1e-6)
+    t_near = mm.transfer_time(0, 1, 1e9)
+    t_far = mm.transfer_time(0, 10, 1e9)
+    assert 0 < t_near <= t_far
+    assert mm.transfer_time(3, 3, 1e9) == 0.0
+    ar = mm.allreduce_time(list(range(4)), 1e9)
+    assert ar > 0
+
+
+def test_profiler_trace(tmp_path):
+    from flexflow_tpu.utils.profiling import profiler_trace
+
+    model = _small_model()
+    model.compile()
+    x = np.random.RandomState(0).randn(16, 32).astype(np.float32)
+    logdir = str(tmp_path / "trace")
+    with profiler_trace(logdir):
+        model.predict(x)
+    assert os.path.isdir(logdir) and os.listdir(logdir)
