@@ -629,10 +629,13 @@ class FFModel:
             # --export-strategy-computation-graph-file, model.cc:4218)
             from flexflow_tpu.utils.dot import export_model_dot
 
+            costs = None
+            if self.config.include_costs_dot_graph:
+                costs = self._estimate_layer_costs()
             export_model_dot(
                 self, self.config.export_strategy_file,
                 include_costs=self.config.include_costs_dot_graph,
-                strategy=self.strategy)
+                costs=costs, strategy=self.strategy)
 
         # --- parameter + op-state init ---
         key = jax.random.PRNGKey(self.config.seed)
@@ -968,6 +971,34 @@ class FFModel:
         arr = jnp.asarray(value, dtype=old.dtype)
         assert arr.shape == old.shape, (arr.shape, old.shape)
         self.params[layer_name][weight_name] = jax.device_put(arr, old.sharding)
+
+    def _estimate_layer_costs(self) -> Dict[str, float]:
+        """Per-layer forward-time estimates from the search cost model
+        (feeds --include-costs-dot-graph; reference attaches simulator costs
+        to the exported graph)."""
+        from flexflow_tpu.search.cost_model import CostModel
+        from flexflow_tpu.search.machine_model import MachineModel
+        from flexflow_tpu.search.pcg import PCG
+        from flexflow_tpu.search.strategy import OpStrategy, replicated
+
+        pcg = PCG.from_model(self)
+        machine = MachineModel.from_name(
+            self.config.tpu_chip, self.config.resolve_num_devices())
+        cm = CostModel(machine, axis_degrees={}, training=False)
+        costs: Dict[str, float] = {}
+        for node in pcg.nodes:
+            st = None
+            if self.strategy is not None:
+                st = self.strategy.ops.get(node.name)
+            if st is None:
+                out_nd = len(node.output_shapes[0]) if node.output_shapes \
+                    else 1
+                st = OpStrategy(
+                    input_specs=tuple(replicated(len(s))
+                                      for s in node.input_shapes),
+                    output_spec=replicated(out_nd))
+            costs[node.name] = cm.node_compute_time(node, st).forward_time
+        return costs
 
     def export_dot(self, path: str, include_costs: bool = False,
                    costs=None) -> str:

@@ -38,9 +38,9 @@ def recompile_on_condition(model, rs: RecompileState) -> bool:
         return False
     old_params = model.params or {}
     rs.alter()
-    # rebuild: recompile with the same optimizer/loss/metrics
+    # rebuild: recompile with the same optimizer/loss/metrics/mode
     model.compile(optimizer=model.optimizer, loss_type=model.loss_type,
-                  metrics=model.metrics)
+                  metrics=model.metrics, comp_mode=model.comp_mode)
     for lname, ws in (model.params or {}).items():
         old_ws = old_params.get(lname)
         if not old_ws:

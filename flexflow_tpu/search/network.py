@@ -26,20 +26,23 @@ class NetworkTopology:
     def __init__(self, num_nodes: int):
         self.num_nodes = num_nodes
         self.links: Dict[Edge, float] = {}
+        self._adj: Dict[int, List[Tuple[int, float]]] = {}
 
     def add_link(self, a: int, b: int, bandwidth: float,
                  bidirectional: bool = True):
+        if (a, b) not in self.links:
+            self._adj.setdefault(a, []).append((b, bandwidth))
         self.links[(a, b)] = bandwidth
         if bidirectional:
+            if (b, a) not in self.links:
+                self._adj.setdefault(b, []).append((a, bandwidth))
             self.links[(b, a)] = bandwidth
 
     def neighbors(self, a: int):
-        for (x, y), bw in self.links.items():
-            if x == a:
-                yield y, bw
+        return self._adj.get(a, ())
 
     def degree(self, a: int) -> int:
-        return sum(1 for (x, _y) in self.links if x == a)
+        return len(self._adj.get(a, ()))
 
 
 def torus_topology(dims: Sequence[int], link_bandwidth: float
@@ -175,9 +178,12 @@ class NetworkedMachineModel:
         n = len(nodes)
         if n <= 1:
             return 0.0
-        slowest_link = min(
-            self.routing.bottleneck_bandwidth(
-                self.routing.route(a, b) or [a])
-            for a, b in zip(nodes, list(nodes[1:]) + [nodes[0]]))
+        slowest_link = float("inf")
+        for a, b in zip(nodes, list(nodes[1:]) + [nodes[0]]):
+            path = self.routing.route(a, b)
+            if path is None:      # disconnected participants: impossible
+                return float("inf")
+            slowest_link = min(slowest_link,
+                               self.routing.bottleneck_bandwidth(path))
         return 2.0 * bytes_ * (n - 1) / n / slowest_link \
             + 2 * (n - 1) * self.hop_latency_s

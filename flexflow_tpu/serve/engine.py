@@ -25,7 +25,7 @@ Two engines:
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -158,45 +158,69 @@ class SpecChainEngine:
         return llm_state, ssm_state, new_tok, new_pos, a, n_acc
 
     def _block_impl(self, llm_params, llm_state, ssm_params, ssm_state, tok,
-                    pos, active, n_rounds):
+                    pos, active, n_rounds, remaining):
         R = tok.shape[0]
         d = self.depth
+        max_seq = self.llm.config.max_sequence_length
         rng0 = jax.random.fold_in(self._rng_const, pos.sum())
         # packed output: [R, max_rounds, d+2] = verifier tokens ++ n_acc —
         # the host reads ONE buffer per block (each separate device->host
-        # read costs a full round trip under remote runtimes).
-        packed0 = jnp.zeros((R, self.max_rounds, d + 2), jnp.int32)
+        # read costs a full round trip under remote runtimes). n_acc = -1
+        # marks a round where the request was already done (no tokens).
+        packed0 = jnp.full((R, self.max_rounds, d + 2), 0, jnp.int32)
+        packed0 = packed0.at[:, :, d + 1].set(-1)
+
+        def live_mask(pos, remaining):
+            # a request drafts this round only while it still owes tokens
+            # and a full round of KV slots (pos..pos+d) fits in its cache
+            return active & (remaining > 0) & (pos + d < max_seq)
 
         def cond(carry):
-            return carry[0] < n_rounds
+            i, _ls, _ss, _t, pos, remaining, _p = carry
+            return (i < n_rounds) & jnp.any(live_mask(pos, remaining))
 
         def body(carry):
-            i, llm_state, ssm_state, tok, pos, packed = carry
-            llm_state, ssm_state, tok, pos, a, n_acc = self._round(
+            i, llm_state, ssm_state, tok, pos, remaining, packed = carry
+            act_i = live_mask(pos, remaining)
+            llm_state, ssm_state, ntok, npos, a, n_acc = self._round(
                 llm_params, llm_state, ssm_params, ssm_state, tok, pos,
-                jax.random.fold_in(rng0, i), active)
-            row = jnp.concatenate([a, n_acc[:, None]], axis=1)  # [R, d+2]
+                jax.random.fold_in(rng0, i), act_i)
+            tok = jnp.where(act_i, ntok, tok)
+            pos = jnp.where(act_i, npos, pos)
+            remaining = remaining - jnp.where(act_i, n_acc + 1, 0)
+            row = jnp.concatenate(
+                [a, jnp.where(act_i, n_acc, -1)[:, None]], axis=1)
             packed = jax.lax.dynamic_update_slice(
                 packed, row[:, None, :], (0, i, 0))
-            return i + 1, llm_state, ssm_state, tok, pos, packed
+            return i + 1, llm_state, ssm_state, tok, pos, remaining, packed
 
-        (_, llm_state, ssm_state, _, _, packed) = jax.lax.while_loop(
-            cond, body,
-            (jnp.int32(0), llm_state, ssm_state, tok, pos, packed0))
+        (_, llm_state, ssm_state, _, _, _, packed) = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), llm_state, ssm_state, tok, pos,
+                         remaining, packed0))
         return llm_state, ssm_state, packed
 
     def run_block(self, tok: np.ndarray, pos: np.ndarray, active: np.ndarray,
-                  n_rounds: int) -> Tuple[np.ndarray, np.ndarray]:
-        """Run ``n_rounds`` (<= max_rounds) rounds; returns (a, n_acc).
+                  n_rounds: int,
+                  remaining: Optional[np.ndarray] = None
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+        """Run up to ``n_rounds`` (<= max_rounds) rounds; returns (a, n_acc).
 
         a[r, k] is round k's verifier outputs [depth+1]; the committed
-        tokens for slot r in round k are ``a[r, k, :n_acc[r, k] + 1]``.
-        Rows k >= n_rounds are zero-filled. Updates both models' op_state.
+        tokens for slot r in round k are ``a[r, k, :n_acc[r, k] + 1]``;
+        n_acc[r, k] == -1 means the request drafted nothing that round.
+        ``remaining[r]`` is the generation budget per slot — the device
+        loop exits early once every request has drafted its budget (or hit
+        the KV-cache end), so one call normally finishes a whole request
+        batch. Updates both models' op_state.
         """
         n_rounds = min(int(n_rounds), self.max_rounds)
+        if remaining is None:
+            remaining = np.full(tok.shape, np.iinfo(np.int32).max // 2,
+                                np.int32)
         (self.llm.op_state, self.ssm.op_state, packed) = self._block(
             self.llm.params, self.llm.op_state, self.ssm.params,
             self.ssm.op_state, jnp.asarray(tok), jnp.asarray(pos),
-            jnp.asarray(active), jnp.int32(n_rounds))
+            jnp.asarray(active), jnp.int32(n_rounds),
+            jnp.asarray(remaining, dtype=jnp.int32))
         packed = np.asarray(packed)
         return packed[:, :, :-1], packed[:, :, -1]

@@ -41,12 +41,15 @@ class InferenceManager:
         return forward_with_meta(self.model, params, op_state, meta, rng,
                                  self._compute_dtype)
 
-    def step(self, meta):
+    def step(self, meta, want_output: bool = True):
         """Run one serving step; threads the model's KV caches through.
 
         Returns the op outputs (token ids [R, Q] for graphs ending in
         argmax/sampling). The model's op_state is replaced (old state was
-        donated to the device program).
+        donated to the device program). ``want_output=False`` skips the
+        blocking device->host readback — prefill chunks whose outputs are
+        discarded dispatch asynchronously and overlap with the host
+        building the next batch.
         """
         self._rng, step_rng = jax.random.split(self._rng)
         if self.model.config.inference_debugging:
@@ -61,6 +64,8 @@ class InferenceManager:
         out, new_state = self._step(self.model.params, self.model.op_state,
                                     meta, step_rng)
         self.model.op_state = new_state
+        if not want_output:
+            return None
         return np.asarray(out)
 
     def decode_block(self, tok: np.ndarray, pos: np.ndarray,

@@ -245,7 +245,7 @@ class RequestManager:
                                       cfg.max_tokens_per_batch)
             if rows:
                 meta = self._meta_from_rows(R, chunk, rows)
-                ifm.step(meta)   # outputs at non-final chunks are ignored
+                ifm.step(meta, want_output=False)  # non-final chunk outputs unused
                 for slot, chunk_toks, sp in rows:
                     active[slot].cache_depth = sp + len(chunk_toks)
                 continue
@@ -319,7 +319,7 @@ class RequestManager:
             if rows:
                 ifm.step(BatchMeta(tokens=tokens, positions=positions,
                                    start_pos=start, num_tokens=num,
-                                   active=act))
+                                   active=act), want_output=False)
                 continue
             live, tok, pos, act = sched.assemble_decode()
             if live:
@@ -379,7 +379,7 @@ class RequestManager:
                                       cfg.max_tokens_per_batch)
             if rows:
                 meta = self._meta_from_rows(R, chunk, rows)
-                llm_ifm.step(meta)
+                llm_ifm.step(meta, want_output=False)
                 for slot, toks, sp in rows:
                     active[slot].cache_depth = sp + len(toks)
                 prefilled = True
@@ -388,7 +388,7 @@ class RequestManager:
                                           cfg.max_tokens_per_batch)
                 if rows:
                     meta = self._meta_from_rows(R, chunk, rows)
-                    ifm.step(meta)
+                    ifm.step(meta, want_output=False)
                     for slot, toks, sp in rows:
                         active[slot].ssm_cache_depth[i] = sp + len(toks)
                     prefilled = True
@@ -483,7 +483,7 @@ class RequestManager:
                             >= depth + 1]
                 if rows:
                     meta = self._meta_from_rows(R, chunk, rows)
-                    ifm.step(meta)
+                    ifm.step(meta, want_output=False)
                     for slot, toks, sp in rows:
                         if ifm is llm_ifm:
                             active[slot].cache_depth = sp + len(toks)
@@ -496,20 +496,22 @@ class RequestManager:
                     if req is not None and not req.finished]
             if live:
                 # speculation must not run past the KV cache end: the verify
-                # pass writes at positions pos..pos+depth each round
-                room = min(
-                    max_seq - len(req.tokens) - 1 for req in live)
-                needed = -(-max(self._remaining_budget(req, max_seq)
-                                for req in live) // (depth + 1))
-                rounds = min(needed, cfg.spec_rounds_per_call,
-                             engine.max_rounds)
-                if room < rounds * (depth + 1):
-                    rounds = max(0, room // (depth + 1))
-                if rounds == 0:
+                # pass writes at positions pos..pos+depth each round. A
+                # request can draft only with a full round of KV room (the
+                # prefill loop above only catches its draft cache up in that
+                # case); cramped requests finish through the single-step
+                # path below. The device loop also guards per request and
+                # exits early once every budget is drafted.
+                draftable = [req for req in live
+                             if max_seq - len(req.tokens) - 1 >= depth + 1]
+                cramped = [req for req in live
+                           if max_seq - len(req.tokens) - 1 < depth + 1]
+                rounds = min(cfg.spec_rounds_per_call, engine.max_rounds)
+                if cramped:
                     # cache nearly full: finish remaining tokens one by one
                     # through the non-fused single-step decode path
                     rows = [(req.slot, req.tokens[-1:], len(req.tokens) - 1)
-                            for req in live]
+                            for req in cramped]
                     meta = self._meta_from_rows(R, 1, rows)
                     out = llm_ifm.step(meta)
                     for slot, _t, sp in rows:
@@ -519,20 +521,26 @@ class RequestManager:
                         req.ssm_cache_depth[0] = min(
                             req.ssm_cache_depth.get(0, 0), sp)
                         self._finish_if_done(req, max_seq)
-                else:
+                if draftable:
                     tok = np.zeros((R,), np.int32)
                     pos = np.zeros((R,), np.int32)
                     act = np.zeros((R,), bool)
-                    for req in live:
+                    remaining = np.zeros((R,), np.int32)
+                    for req in draftable:
                         assert req.cache_depth == len(req.tokens) - 1
                         assert req.ssm_cache_depth.get(0) == len(req.tokens) - 1
                         tok[req.slot] = req.tokens[-1]
                         pos[req.slot] = len(req.tokens) - 1
                         act[req.slot] = True
-                    a, n_acc = engine.run_block(tok, pos, act, rounds)
-                    for req in live:
+                        remaining[req.slot] = self._remaining_budget(req,
+                                                                     max_seq)
+                    a, n_acc = engine.run_block(tok, pos, act, rounds,
+                                                remaining)
+                    for req in draftable:
                         for k in range(rounds):
                             n = int(n_acc[req.slot, k])
+                            if n < 0:     # request drafted nothing this round
+                                continue
                             new_toks = [int(t)
                                         for t in a[req.slot, k, : n + 1]]
                             # trim the accepted chunk at the generation

@@ -40,6 +40,18 @@ def test_export_strategy_file_on_compile(tmp_path):
     assert os.path.exists(path)
 
 
+def test_include_costs_dot_graph_emits_costs(tmp_path):
+    path = str(tmp_path / "costs.dot")
+    model = ff.FFModel(ff.FFConfig(batch_size=16,
+                                   export_strategy_file=path,
+                                   include_costs_dot_graph=True))
+    t = model.create_tensor([16, 32], ff.DataType.DT_FLOAT)
+    model.softmax(model.dense(t, 8, name="head"))
+    model.compile()
+    text = open(path).read()
+    assert "cost:" in text
+
+
 def test_pcg_dot():
     from flexflow_tpu.search.pcg import PCG
     from flexflow_tpu.utils.dot import pcg_to_dot

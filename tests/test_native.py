@@ -171,7 +171,7 @@ def test_scheduler_matches_python_request_manager():
     class FakeIFM:
         """Deterministic 'model': next token = (last + position) % 50 + 1."""
 
-        def step(self, meta):
+        def step(self, meta, want_output=True):
             pass
 
         def decode_block(self, tok, pos, act, block):

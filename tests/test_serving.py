@@ -132,6 +132,36 @@ def test_spec_infer_divergent_ssm_still_correct():
         assert incr[tuple(r.input_tokens)][:10] == r.output_tokens[:10]
 
 
+def test_spec_chain_cramped_and_roomy_requests_coexist():
+    """A request whose prompt nearly fills the KV cache (no room to draft a
+    full round) must finish via the single-step path while a roomy request
+    speculates — without tripping the draft-cache assertions."""
+    max_seq = 32
+    depth = 4
+    cramped_prompt = list(range(1, 28))       # room = 32-27-1 = 4 < depth+1
+    roomy_prompt = [5, 9, 23]
+
+    incr_model = make_model(seed=0, max_seq=max_seq)
+    rm = RequestManager()
+    rm.register_new_request(cramped_prompt, max_new_tokens=8)
+    rm.register_new_request(roomy_prompt, max_new_tokens=12)
+    incr = {tuple(r.input_tokens): r.output_tokens
+            for r in rm.generate_incr_decoding(incr_model)}
+    assert len(incr[tuple(cramped_prompt)]) == max_seq - len(cramped_prompt)
+
+    llm = make_model(mode=InferenceMode.TREE_VERIFY_MODE, seed=0,
+                     max_seq=max_seq)
+    ssm = make_model(mode=InferenceMode.BEAM_SEARCH_MODE, seed=0,
+                     max_seq=max_seq)
+    rm2 = RequestManager()
+    rm2.register_new_request(cramped_prompt, max_new_tokens=8)
+    rm2.register_new_request(roomy_prompt, max_new_tokens=12)
+    spec = rm2.generate_spec_infer(llm, [ssm], spec_depth=depth)
+    assert len(spec) == 2
+    for r in spec:
+        assert incr[tuple(r.input_tokens)] == r.output_tokens
+
+
 def test_spec_infer_eos_and_budget_respected():
     """EOS accepted mid-chunk must stop generation exactly there, and the
     output must never exceed max_new_tokens (matching incremental)."""
