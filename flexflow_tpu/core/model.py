@@ -571,16 +571,21 @@ class FFModel:
         values: Dict[int, Any] = dict(feeds)
         ctx.state_in = state or {}
         ctx.state_out = {}
+        from flexflow_tpu.offload import fetch_layer_params
         from flexflow_tpu.quant import dequantize_layer_params
 
+        offloaded = getattr(self, "_offloaded", None) or {}
         for layer in self.layers:
             impl = get_op_impl(layer.op_type)
             ins = [values[t.tensor_id] for t in layer.inputs]
             ctx.layer_name = layer.name
-            # int8/int4 weights dequantize lazily here, inside the jitted
-            # step, so HBM holds (and streams) the compressed form
-            lp = dequantize_layer_params(params.get(layer.name, {}),
-                                         ctx.compute_dtype)
+            # host-offloaded weights stream back to HBM first (in their
+            # compressed form), then int8/int4 dequantizes lazily — all
+            # inside the jitted step so XLA overlaps transfer with compute
+            lp = params.get(layer.name, {})
+            if layer.name in offloaded:
+                lp = fetch_layer_params(lp, offloaded[layer.name])
+            lp = dequantize_layer_params(lp, ctx.compute_dtype)
             outs = impl.forward(layer.attrs, lp, ins, ctx)
             if self.strategy is not None and self.policy is not None:
                 strat_op = self.strategy.ops.get(layer.name)
@@ -935,6 +940,17 @@ class FFModel:
             return np.asarray(dequantize_array(leaf))
         return np.asarray(leaf)
 
+    def offload_weights(self, min_bytes: int = 1 << 20) -> int:
+        """Page big weights to pinned host memory; the jitted step streams
+        them back per layer (reference -offload mode, config.h:144;
+        compute path in flexflow_tpu/offload.py). Returns bytes moved."""
+        from flexflow_tpu.offload import offload_model_weights
+
+        moved = offload_model_weights(self, min_bytes=min_bytes)
+        if self.config.profiling:
+            print(f"offload_weights: {moved / 1e6:.1f}MB -> pinned_host")
+        return moved
+
     def quantize_weights(self, qtype: str):
         """Compress eligible weights to int8/int4 on device (reference
         4/8-bit weight quantization, config.h:161-163; compute path in
@@ -984,7 +1000,9 @@ class FFModel:
         pcg = PCG.from_model(self)
         machine = MachineModel.from_name(
             self.config.tpu_chip, self.config.resolve_num_devices())
-        cm = CostModel(machine, axis_degrees={}, training=False)
+        axis_degrees = (dict(self.mesh.shape)
+                        if getattr(self, "mesh", None) is not None else {})
+        cm = CostModel(machine, axis_degrees=axis_degrees, training=False)
         costs: Dict[str, float] = {}
         for node in pcg.nodes:
             st = None

@@ -1,0 +1,95 @@
+"""CPU (host-memory) weight offload for serving.
+
+Capability parity with the reference's ``-offload`` mode (config.h:144-146,
+linear_kernels.cu:30-40: weights paged from CPU pinned memory into a
+reserved GPU scratch region per use). TPU-idiomatic design: offloaded
+weights live in ``pinned_host`` device memory (host RAM reachable by the
+TPU's DMA engines); inside the jitted step each layer's weights are
+``jax.device_put`` back to ``device`` (HBM) right before use, so XLA
+schedules the host->HBM stream and overlaps it with compute — the moral
+equivalent of the reference's paging, without a hand-managed scratch pool.
+
+Composes with int8/int4 quantization (flexflow_tpu/quant.py): quantize
+first, then offload — the host->HBM stream then moves 4-8x fewer bytes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+
+from flexflow_tpu.quant import QuantizedWeight, is_quantized
+
+# weight names worth paging (the big serving matmuls; same set as quant)
+_OFFLOAD_NAMES = {"kernel", "wq", "wk", "wv", "wo", "weight",
+                  "w1", "w2", "w3", "gate", "up", "down"}
+
+
+def host_memory_supported() -> bool:
+    try:
+        dev = jax.devices()[0]
+        return "pinned_host" in {m.kind for m in dev.addressable_memories()}
+    except Exception:
+        return False
+
+
+def _to_host(arr):
+    return jax.device_put(arr, arr.sharding.with_memory_kind("pinned_host"))
+
+
+def offload_model_weights(model, min_bytes: int = 1 << 20) -> int:
+    """Move eligible weights to pinned host memory.
+
+    Records each weight's original device sharding in
+    ``model._offloaded[layer][name]`` so the jitted step can stream it
+    back per use. Returns the number of bytes moved off HBM; 0 when the
+    backend has no host memory space.
+    """
+    if not host_memory_supported():
+        return 0
+    offloaded: Dict[str, Dict[str, Any]] = {}
+    moved = 0
+    for lname, ws in (model.params or {}).items():
+        for wname, w in ws.items():
+            if wname not in _OFFLOAD_NAMES:
+                continue
+            if is_quantized(w):
+                if w.nbytes < min_bytes:
+                    continue
+                dev_sh = {"q": w.q.sharding, "scale": w.scale.sharding}
+                w.q = _to_host(w.q)
+                w.scale = _to_host(w.scale)
+                moved += w.nbytes
+            else:
+                if getattr(w, "nbytes", 0) < min_bytes or w.ndim < 2:
+                    continue
+                dev_sh = w.sharding
+                ws[wname] = _to_host(w)
+                moved += w.nbytes
+            offloaded.setdefault(lname, {})[wname] = dev_sh
+    model._offloaded = offloaded
+    return moved
+
+
+def fetch_layer_params(lp: Optional[Dict[str, Any]],
+                       off_map: Optional[Dict[str, Any]]):
+    """Stream a layer's offloaded weights back to HBM (called inside the
+    jitted step, BEFORE dequantization — the transfer moves the compressed
+    form)."""
+    if not lp or not off_map:
+        return lp
+    out = dict(lp)
+    for wname, dev_sh in off_map.items():
+        w = out.get(wname)
+        if w is None:
+            continue
+        if isinstance(w, QuantizedWeight):
+            out[wname] = QuantizedWeight(
+                w.qtype,
+                jax.device_put(w.q, dev_sh["q"]),
+                jax.device_put(w.scale, dev_sh["scale"]),
+                w.rows, w.dtype)
+        else:
+            out[wname] = jax.device_put(w, dev_sh)
+    return out

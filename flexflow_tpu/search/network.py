@@ -30,13 +30,19 @@ class NetworkTopology:
 
     def add_link(self, a: int, b: int, bandwidth: float,
                  bidirectional: bool = True):
-        if (a, b) not in self.links:
-            self._adj.setdefault(a, []).append((b, bandwidth))
-        self.links[(a, b)] = bandwidth
+        def upsert(x, y, bw):
+            adj = self._adj.setdefault(x, [])
+            for i, (node, _old) in enumerate(adj):
+                if node == y:      # re-adding updates the bandwidth in both
+                    adj[i] = (y, bw)
+                    break
+            else:
+                adj.append((y, bw))
+            self.links[(x, y)] = bw
+
+        upsert(a, b, bandwidth)
         if bidirectional:
-            if (b, a) not in self.links:
-                self._adj.setdefault(b, []).append((a, bandwidth))
-            self.links[(b, a)] = bandwidth
+            upsert(b, a, bandwidth)
 
     def neighbors(self, a: int):
         return self._adj.get(a, ())

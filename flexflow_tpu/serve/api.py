@@ -169,6 +169,11 @@ class LLM:
             # 4/8-bit weight-only compression (reference --4bit/--8bit-
             # quantization flags): done post-load so scales see real weights
             self.ffmodel.quantize_weights(config.quantization_type)
+        if config.cpu_offload:
+            # page (possibly compressed) weights to pinned host memory
+            # (reference -offload); quantize-then-offload streams 4-8x
+            # fewer bytes per step
+            self.ffmodel.offload_weights()
 
         self.rm = RequestManager()
         if self.tokenizer is not None:

@@ -1,0 +1,75 @@
+"""CPU (host-memory) weight offload tests (reference -offload mode)."""
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.offload import host_memory_supported
+
+needs_host_mem = pytest.mark.skipif(not host_memory_supported(),
+                                    reason="no pinned_host memory space")
+
+
+def _model(batch=16):
+    model = ff.FFModel(ff.FFConfig(batch_size=batch))
+    t = model.create_tensor([batch, 256], ff.DataType.DT_FLOAT)
+    x = model.dense(t, 256, ff.ActiMode.AC_MODE_RELU)
+    x = model.dense(x, 64)
+    model.softmax(x)
+    model.compile()
+    return model
+
+
+@needs_host_mem
+def test_offload_predict_identical():
+    model = _model()
+    x = np.random.RandomState(0).randn(16, 256).astype(np.float32)
+    full = model.predict(x)
+    moved = model.offload_weights(min_bytes=1024)
+    assert moved > 0
+    # weights actually live in host memory now
+    k = model.params["linear"]["kernel"]
+    assert k.sharding.memory_kind == "pinned_host"
+    got = model.predict(x)
+    np.testing.assert_allclose(got, full, rtol=1e-6, atol=1e-7)
+
+
+@needs_host_mem
+def test_offload_composes_with_quantization():
+    model = _model()
+    x = np.random.RandomState(1).randn(16, 256).astype(np.float32)
+    full = model.predict(x)
+    model.quantize_weights("int8")
+    moved = model.offload_weights(min_bytes=1024)
+    assert moved > 0
+    qw = model.params["linear"]["kernel"]
+    assert qw.q.sharding.memory_kind == "pinned_host"
+    got = model.predict(x)
+    rel = np.abs(got - full).max() / max(1e-6, np.abs(full).max())
+    assert rel < 0.02
+
+
+@needs_host_mem
+def test_offload_serving_generates():
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+    from flexflow_tpu import serve as ff_serve
+
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(transformers.LlamaConfig(
+        vocab_size=256, hidden_size=128, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=False))
+    hf.eval()
+
+    llm_full = ff_serve.LLM(hf)
+    llm_full.compile(max_requests_per_batch=2, max_seq_length=64,
+                     max_tokens_per_batch=16, kv_cache_dtype="float32")
+    full = llm_full.generate([5, 9, 23, 44], max_new_tokens=8)
+
+    llm = ff_serve.LLM(hf)
+    llm.compile(max_requests_per_batch=2, max_seq_length=64,
+                max_tokens_per_batch=16, kv_cache_dtype="float32",
+                cpu_offload=True)
+    res = llm.generate([5, 9, 23, 44], max_new_tokens=8)
+    assert res.output_tokens == full.output_tokens
