@@ -21,9 +21,11 @@ import jax
 
 from flexflow_tpu.quant import QuantizedWeight, is_quantized
 
-# weight names worth paging (the big serving matmuls; same set as quant)
-_OFFLOAD_NAMES = {"kernel", "wq", "wk", "wv", "wo", "weight",
-                  "w1", "w2", "w3", "gate", "up", "down"}
+from flexflow_tpu.quant import _QUANT_NAMES
+
+# weight names worth paging: the big serving matmuls — one shared set with
+# quantization so the two features always cover the same weights
+_OFFLOAD_NAMES = _QUANT_NAMES
 
 
 def host_memory_supported() -> bool:
@@ -48,21 +50,29 @@ def offload_model_weights(model, min_bytes: int = 1 << 20) -> int:
     """
     if not host_memory_supported():
         return 0
-    offloaded: Dict[str, Dict[str, Any]] = {}
+    # idempotent: weights already in pinned_host are skipped, so a second
+    # call never records a host sharding as the stream-back target
+    offloaded: Dict[str, Dict[str, Any]] = dict(
+        getattr(model, "_offloaded", None) or {})
     moved = 0
+
+    def on_host(arr):
+        return getattr(arr.sharding, "memory_kind", None) == "pinned_host"
+
     for lname, ws in (model.params or {}).items():
         for wname, w in ws.items():
             if wname not in _OFFLOAD_NAMES:
                 continue
             if is_quantized(w):
-                if w.nbytes < min_bytes:
+                if w.nbytes < min_bytes or on_host(w.q):
                     continue
                 dev_sh = {"q": w.q.sharding, "scale": w.scale.sharding}
                 w.q = _to_host(w.q)
                 w.scale = _to_host(w.scale)
                 moved += w.nbytes
             else:
-                if getattr(w, "nbytes", 0) < min_bytes or w.ndim < 2:
+                if getattr(w, "nbytes", 0) < min_bytes or w.ndim < 2 \
+                        or on_host(w):
                     continue
                 dev_sh = w.sharding
                 ws[wname] = _to_host(w)
