@@ -1,2 +1,7 @@
 from flexflow_tpu.parallel.mesh import MachineResource, make_mesh
+from flexflow_tpu.parallel.pipeline import (
+    pipeline_spmd,
+    shard_stacked_params,
+    stack_stage_params,
+)
 from flexflow_tpu.parallel.spec import ShardingPolicy
