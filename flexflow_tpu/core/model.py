@@ -514,6 +514,20 @@ class FFModel:
                                     experts_internal_dim_size=experts_internal_dim_size,
                                     activation=activation, use_bias=use_bias), name)
 
+    def cache(self, input: Tensor, num_batches: int = 1, name=None):
+        """Cross-batch activation cache with staleness score (reference
+        src/ops/cache.cc; pairs with RecompileState for adaptive MoE)."""
+        return self._add_layer(OpType.CACHE, [input],
+                               dict(num_batches=num_batches), name)
+
+    def get_cache_score(self, layer_name: str) -> float:
+        """Host-side read of a Cache op's staleness score (reference
+        cache.cc score trigger feeding recompile decisions)."""
+        st = (self.op_state or {}).get(layer_name)
+        if st is None or "score" not in st:
+            raise KeyError(f"no cache state for layer {layer_name!r}")
+        return float(st["score"])
+
     def moe(self, input: Tensor, num_exp: int, num_select: int,
             expert_hidden_size: int, alpha: float = 2.0, lambda_bal: float = 0.0):
         """Composite MoE layer (reference src/ops/moe.cc:44

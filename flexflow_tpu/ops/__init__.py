@@ -7,6 +7,7 @@ registered by OpType. Importing this package registers all ops.
 from flexflow_tpu.ops import base  # noqa: F401
 from flexflow_tpu.ops import (  # noqa: F401
     attention,
+    cache,
     conv,
     dropout,
     elementwise,

@@ -1,0 +1,60 @@
+"""Cache operator: cross-batch activation cache with a staleness score.
+
+Capability parity with reference src/ops/cache.cc (294 LoC): the MoE
+examples cache gating decisions across batches and use a score (how much
+fresh activations deviate from the cached ones) to trigger dynamic
+recompilation (reference moe.cc + RecompileState). Here the cache is a ring
+buffer in op_state (threaded through the jitted step like KV caches) and
+the score is a device scalar read host-side by recompile triggers via
+FFModel.get_cache_score().
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from flexflow_tpu.ffconst import OpType
+from flexflow_tpu.ops.base import OpImpl, register_op
+
+
+@register_op
+class Cache(OpImpl):
+    op_type = OpType.CACHE
+
+    @staticmethod
+    def infer_output_specs(attrs, input_specs):
+        return [input_specs[0]]
+
+    @staticmethod
+    def init_state(attrs, input_specs):
+        (shape, dtype) = input_specs[0]
+        n = attrs.get("num_batches", 1)
+        return {
+            "cache": jnp.zeros((n,) + tuple(shape), jnp.float32),
+            "batch_ctr": jnp.zeros((), jnp.int32),
+            "score": jnp.zeros((), jnp.float32),
+        }
+
+    @staticmethod
+    def forward(attrs, params, inputs, ctx):
+        x = inputs[0]
+        st = ctx.state_in.get(ctx.layer_name)
+        if st is not None:
+            n = st["cache"].shape[0]
+            slot = st["batch_ctr"] % n
+            prev = st["cache"][slot]
+            xf = x.astype(jnp.float32)
+            # staleness score: mean relative delta vs the cached batch
+            # (reference's score function deciding cache validity); zero
+            # while the ring buffer is still warming up — the cache is not
+            # yet valid, so triggers must not fire on the first n batches
+            denom = jnp.maximum(jnp.mean(jnp.abs(prev)), 1e-6)
+            warm = st["batch_ctr"] >= n
+            score = jnp.where(warm,
+                              jnp.mean(jnp.abs(xf - prev)) / denom, 0.0)
+            ctx.state_out[ctx.layer_name] = {
+                "cache": st["cache"].at[slot].set(xf),
+                "batch_ctr": st["batch_ctr"] + 1,
+                "score": score,
+            }
+        return [x]

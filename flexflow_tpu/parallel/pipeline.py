@@ -20,12 +20,13 @@ follows the scaling-book recipe instead of task placement:
 
 from __future__ import annotations
 
-import functools
 from typing import Callable
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+from flexflow_tpu.parallel.collectives import ppermute_shift
 
 
 def stack_stage_params(per_layer_params: list):
@@ -78,8 +79,6 @@ def pipeline_spmd(block_fn: Callable, mesh, num_microbatches: int,
             out, _ = jax.lax.scan(local_blocks, v, local_params)
             return out
 
-        perm = [(i, (i + 1) % nstages) for i in range(nstages)]
-
         def tick(carry, t):
             buf, outputs = carry
             # stage 0 ingests microbatch t; others take last tick's handoff
@@ -92,7 +91,7 @@ def pipeline_spmd(block_fn: Callable, mesh, num_microbatches: int,
             outputs = jnp.where(
                 take, outputs.at[jnp.clip(out_idx, 0, M - 1)].set(y),
                 outputs)
-            buf = jax.lax.ppermute(y, P_axis, perm)
+            buf = ppermute_shift(y, P_axis)
             return (buf, outputs), None
 
         local_params = stacked_params      # [L/P, ...] after shard_map split

@@ -162,6 +162,40 @@ def test_recompile_state():
     assert fired["n"] == 1
 
 
+def test_cache_op_score_feeds_recompile_trigger():
+    """Cache op (reference src/ops/cache.cc): staleness score over cached
+    activations drives a RecompileState trigger, as in the MoE example."""
+    from flexflow_tpu.core.recompile import RecompileState
+
+    model = ff.FFModel(ff.FFConfig(batch_size=8))
+    t = model.create_tensor([8, 16], ff.DataType.DT_FLOAT)
+    x = model.dense(t, 16, ff.ActiMode.AC_MODE_RELU, name="gate")
+    x = model.cache(x, num_batches=1, name="gate_cache")
+    model.softmax(model.dense(x, 4, name="head"))
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.0),
+                  loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[])
+
+    rng = np.random.RandomState(0)
+    a = rng.randn(8, 16).astype(np.float32)
+    y = rng.randint(0, 4, (8, 1)).astype(np.int32)
+    model.train_one_batch([a], y)
+    model.train_one_batch([a], y)           # identical batch: score ~ 0
+    low = model.get_cache_score("gate_cache")
+    assert low < 0.05, low
+    b = rng.randn(8, 16).astype(np.float32) * 3
+    model.train_one_batch([b], y)           # shifted batch: score jumps
+    high = model.get_cache_score("gate_cache")
+    assert high > low
+
+    fired = []
+    rs = RecompileState(
+        lambda: model.get_cache_score("gate_cache") > max(low, 0.05),
+        lambda _rs: fired.append(True), model)
+    assert model.recompile_on_condition(rs)
+    assert fired == [True]
+
+
 def test_network_topologies_and_routing():
     from flexflow_tpu.search.network import (
         NetworkedMachineModel,
