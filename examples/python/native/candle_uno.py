@@ -1,0 +1,59 @@
+"""Candle-UNO-style multi-tower regressor (reference
+examples/cpp/candle_uno/candle_uno.cc: per-feature-set towers feeding a
+shared residual MLP head, drug-response regression).
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu as ff
+
+# feature-set widths (stand-ins for the reference's gene/drug descriptors)
+TOWERS = {"gene": 942, "drug1": 532, "drug2": 532}
+TOWER_UNITS = [256, 128]
+HEAD_UNITS = [256, 128, 64]
+
+
+def build_tower(model, t, units):
+    x = t
+    for u in units:
+        x = model.dense(x, u, ff.ActiMode.AC_MODE_RELU)
+    return x
+
+
+def top_level_task():
+    config = ff.FFConfig.from_args()
+    model = ff.FFModel(config)
+    B = config.batch_size
+
+    inputs = {name: model.create_tensor([B, width], ff.DataType.DT_FLOAT)
+              for name, width in TOWERS.items()}
+    towers = [build_tower(model, t, TOWER_UNITS)
+              for t in inputs.values()]
+    x = model.concat(towers, axis=1)
+    for u in HEAD_UNITS:
+        h = model.dense(x, u, ff.ActiMode.AC_MODE_RELU)
+        # residual connection when widths line up (reference
+        # candle_uno.cc residual flag)
+        x = model.add(h, x) if h.dims == x.dims else h
+    out = model.dense(x, 1)
+
+    model.compile(
+        optimizer=ff.AdamOptimizer(model, alpha=1e-3),
+        loss_type=ff.LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[ff.MetricsType.METRICS_MEAN_SQUARED_ERROR])
+
+    rng = np.random.RandomState(config.seed)
+    n = 1024
+    feats = [rng.rand(n, w).astype(np.float32) for w in TOWERS.values()]
+    y = sum(f.mean(axis=1) for f in feats).reshape(-1, 1).astype(np.float32)
+    model.fit(feats, y, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,77 @@
+"""InceptionV3-style trainer (reference examples/cpp/InceptionV3/
+inception.cc:26 InceptionA/B/C/D/E modules, python twin
+examples/python/native/inception.py): parallel conv branches concatenated
+on the channel dim. Scaled-down input by default so it runs anywhere.
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu as ff
+
+
+def conv_bn(model, x, ch, kh, kw, sh=1, sw=1, ph=0, pw=0):
+    x = model.conv2d(x, ch, kh, kw, sh, sw, ph, pw)
+    return model.batch_norm(x, relu=True)
+
+
+def inception_a(model, x, pool_ch):
+    """Reference InceptionA (inception.cc:26): 1x1 / 5x5 / double-3x3 /
+    pool branches."""
+    b1 = conv_bn(model, x, 64, 1, 1)
+    b2 = conv_bn(model, x, 48, 1, 1)
+    b2 = conv_bn(model, b2, 64, 5, 5, 1, 1, 2, 2)
+    b3 = conv_bn(model, x, 64, 1, 1)
+    b3 = conv_bn(model, b3, 96, 3, 3, 1, 1, 1, 1)
+    b3 = conv_bn(model, b3, 96, 3, 3, 1, 1, 1, 1)
+    b4 = model.pool2d(x, 3, 3, 1, 1, 1, 1, ff.PoolType.POOL_AVG)
+    b4 = conv_bn(model, b4, pool_ch, 1, 1)
+    return model.concat([b1, b2, b3, b4], axis=1)
+
+
+def inception_b(model, x):
+    """Reference InceptionB: grid-size reduction."""
+    b1 = conv_bn(model, x, 96, 3, 3, 2, 2)
+    b2 = conv_bn(model, x, 64, 1, 1)
+    b2 = conv_bn(model, b2, 96, 3, 3, 1, 1, 1, 1)
+    b2 = conv_bn(model, b2, 96, 3, 3, 2, 2)
+    b3 = model.pool2d(x, 3, 3, 2, 2, 0, 0)
+    return model.concat([b1, b2, b3], axis=1)
+
+
+def top_level_task(n_samples=64, size=75):
+    config = ff.FFConfig.from_args()
+    config.batch_size = min(config.batch_size, n_samples)
+    model = ff.FFModel(config)
+    t = model.create_tensor([config.batch_size, 3, size, size],
+                            ff.DataType.DT_FLOAT)
+    x = conv_bn(model, t, 32, 3, 3, 2, 2)
+    x = conv_bn(model, x, 64, 3, 3, 1, 1, 1, 1)
+    x = model.pool2d(x, 3, 3, 2, 2, 0, 0)
+    x = inception_a(model, x, 32)
+    x = inception_a(model, x, 64)
+    x = inception_b(model, x)
+    x = model.pool2d(x, 8, 8, 1, 1, 0, 0, ff.PoolType.POOL_AVG)
+    x = model.flat(x)
+    x = model.dense(x, 10)
+    model.softmax(x)
+
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=config.learning_rate,
+                                  momentum=0.9),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY])
+
+    rng = np.random.RandomState(config.seed)
+    xs = rng.randn(n_samples, 3, size, size).astype(np.float32)
+    ys = rng.randint(0, 10, size=(n_samples, 1)).astype(np.int32)
+    model.fit(xs, ys, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

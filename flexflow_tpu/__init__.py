@@ -50,6 +50,7 @@ from flexflow_tpu.training.checkpoint import (
     load_weights_npz,
     save_weights_npz,
 )
+from flexflow_tpu import distributed
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,87 @@
+"""Multi-host runtime initialization.
+
+Capability parity with the reference's multi-node launch path
+(MULTI-NODE.md: mpirun over GASNet/UCX conduits + NCCL communicators). The
+TPU-native equivalent is the single jax distributed runtime: every host
+calls :func:`initialize` (directly or via the TPU-pod auto-detection),
+after which ``jax.devices()`` spans all hosts and the meshes built by
+``parallel/mesh.py`` lay parallelism axes across the whole slice — ICI
+collectives within a slice, DCN across slices; no separate comm library.
+
+On a Cloud TPU pod slice ``initialize()`` with no arguments auto-detects
+coordinator/process ids from the TPU metadata (jax.distributed does this);
+on CPU/GPU clusters pass coordinator_address/num_processes/process_id or
+set the standard env vars (JAX_COORDINATOR_ADDRESS, JAX_NUM_PROCESSES,
+JAX_PROCESS_ID — mirroring the reference's mpirun-provided ranks).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None,
+               local_device_ids=None) -> bool:
+    """Start (or join) the jax distributed runtime. Idempotent; returns
+    True when multi-process mode is active, False for single-process runs
+    (no coordinator configured — the common laptop/single-host case)."""
+    global _initialized
+    import jax
+
+    if _initialized:
+        return True
+    coordinator_address = (coordinator_address
+                           or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+
+    if coordinator_address is not None:
+        # explicitly configured: a failure here is a real misconfiguration
+        # and must surface (a swallowed error would leave this host
+        # single-process while its peers block on the barrier)
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id,
+                                   local_device_ids=local_device_ids)
+        _initialized = True
+        return True
+
+    if os.environ.get("FF_DISABLE_DISTRIBUTED") == "1":
+        return False
+    # no explicit config: delegate pod auto-detection to jax itself (it
+    # reads the Cloud TPU metadata on single- and multi-slice pods); on a
+    # non-pod machine the bare call raises and we stay single-process
+    try:
+        jax.distributed.initialize()
+    except (ValueError, RuntimeError):
+        return False
+    _initialized = True
+    return True
+
+
+def process_info():
+    """(process_id, num_processes, local_device_count, global_device_count)."""
+    import jax
+
+    return (jax.process_index(), jax.process_count(),
+            jax.local_device_count(), jax.device_count())
+
+
+def host_local_batch(global_batch: int) -> int:
+    """Per-host batch size for a globally-sharded input pipeline
+    (the reference's per-node dataloader split)."""
+    import jax
+
+    n = jax.process_count()
+    if global_batch % n:
+        raise ValueError(f"global batch {global_batch} not divisible by "
+                         f"{n} processes")
+    return global_batch // n

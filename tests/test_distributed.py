@@ -1,0 +1,33 @@
+"""Multi-host init helper tests (reference multi-node launch parity;
+real multi-host needs real hosts — like the reference's 2-node CI — so
+these cover the single-process behavior and the helper math)."""
+
+import pytest
+
+import flexflow_tpu as ff
+
+
+def test_initialize_single_process_noop():
+    # no coordinator configured: stays single-process, returns False,
+    # and is safe to call repeatedly
+    assert ff.distributed.initialize() is False
+    assert ff.distributed.initialize() is False
+
+
+def test_process_info_single():
+    pid, n, local, global_ = ff.distributed.process_info()
+    assert pid == 0 and n == 1
+    assert local == global_ > 0
+
+
+def test_host_local_batch():
+    assert ff.distributed.host_local_batch(64) == 64
+    with pytest.raises(ValueError):
+        # simulate divisibility error by monkeypatching process_count
+        import jax
+        orig = jax.process_count
+        jax.process_count = lambda: 3
+        try:
+            ff.distributed.host_local_batch(64)
+        finally:
+            jax.process_count = orig
