@@ -16,7 +16,9 @@ import flexflow_tpu as ff
 # feature-set widths (stand-ins for the reference's gene/drug descriptors)
 TOWERS = {"gene": 942, "drug1": 532, "drug2": 532}
 TOWER_UNITS = [256, 128]
-HEAD_UNITS = [256, 128, 64]
+# equal widths so the residual adds actually fire (reference
+# candle_uno.cc residual flag adds every equal-width consecutive pair)
+HEAD_UNITS = [256, 256, 256]
 
 
 def build_tower(model, t, units):

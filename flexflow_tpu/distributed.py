@@ -36,12 +36,22 @@ def initialize(coordinator_address: Optional[str] = None,
 
     if _initialized:
         return True
+    if os.environ.get("FF_DISABLE_DISTRIBUTED") == "1":
+        # explicit kill switch wins over any env/arg configuration
+        return False
     coordinator_address = (coordinator_address
                            or os.environ.get("JAX_COORDINATOR_ADDRESS"))
     if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
         num_processes = int(os.environ["JAX_NUM_PROCESSES"])
     if process_id is None and os.environ.get("JAX_PROCESS_ID"):
         process_id = int(os.environ["JAX_PROCESS_ID"])
+    if coordinator_address is None and (num_processes is not None
+                                        or process_id is not None):
+        raise ValueError(
+            "JAX_NUM_PROCESSES/JAX_PROCESS_ID are set but no coordinator "
+            "address — set JAX_COORDINATOR_ADDRESS (or pass "
+            "coordinator_address) so this host joins the job instead of "
+            "silently running single-process while peers block")
 
     if coordinator_address is not None:
         # explicitly configured: a failure here is a real misconfiguration
@@ -54,8 +64,6 @@ def initialize(coordinator_address: Optional[str] = None,
         _initialized = True
         return True
 
-    if os.environ.get("FF_DISABLE_DISTRIBUTED") == "1":
-        return False
     # no explicit config: delegate pod auto-detection to jax itself (it
     # reads the Cloud TPU metadata on single- and multi-slice pods); on a
     # non-pod machine the bare call raises and we stay single-process

@@ -21,10 +21,11 @@ TINY = LLAMAConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
 
 
 def make_model(mode=InferenceMode.INC_DECODING_MODE, seed=0, max_requests=4,
-               max_seq=64):
+               max_seq=64, tp=1):
     cfg = ff.FFConfig(max_requests_per_batch=max_requests,
                       max_sequence_length=max_seq, max_tokens_per_batch=16,
-                      seed=seed, kv_cache_dtype="float32")
+                      seed=seed, kv_cache_dtype="float32",
+                      tensor_parallelism_degree=tp)
     model = ff.FFModel(cfg)
     create_llama_model(model, TINY, mode=mode)
     model.compile(comp_mode=ff.CompMode.COMP_MODE_INFERENCE)
@@ -130,6 +131,52 @@ def test_spec_infer_divergent_ssm_still_correct():
     spec = rm2.generate_spec_infer(llm, [ssm], spec_depth=4)
     for r in spec:
         assert incr[tuple(r.input_tokens)][:10] == r.output_tokens[:10]
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_incr_decoding_tensor_parallel_matches(tp):
+    """Serving under TP must be token-identical to single-device — the
+    reference inference CI's TP-config matrix
+    (tests/inference/python_test_configs/generate_configs.py)."""
+    import jax
+    if len(jax.devices()) < tp:
+        pytest.skip("not enough devices")
+
+    def gen(degree):
+        m = make_model(max_requests=2, tp=degree)
+        rm = RequestManager()
+        rm.register_new_request([5, 9, 23, 44], max_new_tokens=8)
+        rm.register_new_request([7, 3], max_new_tokens=8)
+        return {tuple(r.input_tokens): r.output_tokens
+                for r in rm.generate_incr_decoding(m)}
+
+    assert gen(1) == gen(tp)
+
+
+def test_spec_infer_tensor_parallel_matches():
+    """Speculative serving under TP=2 token-matches incremental (the
+    reference CI runs spec_infer across its TP configs too)."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("not enough devices")
+    prompts = [[5, 9, 23, 44]]
+
+    rm = RequestManager()
+    for p in prompts:
+        rm.register_new_request(p, max_new_tokens=10)
+    incr = {tuple(r.input_tokens): r.output_tokens
+            for r in rm.generate_incr_decoding(
+                make_model(InferenceMode.INC_DECODING_MODE, max_requests=2,
+                           tp=2))}
+
+    llm = make_model(InferenceMode.TREE_VERIFY_MODE, max_requests=2, tp=2)
+    ssm = make_model(InferenceMode.BEAM_SEARCH_MODE, max_requests=2, tp=2)
+    rm2 = RequestManager()
+    for p in prompts:
+        rm2.register_new_request(p, max_new_tokens=10)
+    spec = rm2.generate_spec_infer(llm, [ssm], spec_depth=4)
+    for r in spec:
+        assert incr[tuple(r.input_tokens)] == r.output_tokens
 
 
 def test_spec_chain_cramped_and_roomy_requests_coexist():
