@@ -48,6 +48,27 @@ from flexflow_tpu.training.loss import compute_loss
 from flexflow_tpu.training.metrics import PerfMetrics, compute_step_metrics
 
 
+def _normalize_regularizer(reg):
+    """Normalize a regularizer spec to None or a non-empty list of
+    ("l1"|"l2", float) pairs; reject unknown kinds with a clear error."""
+    if reg is None:
+        return None
+    if hasattr(reg, "to_attr"):          # keras.regularizers.* instance
+        reg = reg.to_attr()
+    if isinstance(reg, (list, tuple)) and reg \
+            and not isinstance(reg[0], (list, tuple)):
+        reg = [reg]                      # single ("l2", c) pair
+    out = []
+    for item in reg or []:
+        kind, coeff = item
+        if kind not in ("l1", "l2"):
+            raise ValueError(f"unknown regularizer kind {kind!r} "
+                             f"(expected 'l1' or 'l2')")
+        if coeff:
+            out.append((kind, float(coeff)))
+    return out or None
+
+
 class FFModel:
     def __init__(self, config: Optional[FFConfig] = None):
         self.config = config or FFConfig()
@@ -129,11 +150,16 @@ class FFModel:
               activation: ActiMode = ActiMode.AC_MODE_NONE,
               use_bias: bool = True, datatype: Optional[DataType] = None,
               kernel_initializer=None, bias_initializer=None,
+              kernel_regularizer=None,
               name: Optional[str] = None) -> Tensor:
+        """kernel_regularizer: ("l1"|"l2", coeff) or a list of such pairs —
+        added to the training loss (reference keras regularizers)."""
         return self._add_layer(OpType.LINEAR, [input], dict(
             out_dim=out_dim, activation=activation, use_bias=use_bias,
             data_type=datatype, kernel_initializer=kernel_initializer,
-            bias_initializer=bias_initializer), name)
+            bias_initializer=bias_initializer,
+            kernel_regularizer=_normalize_regularizer(kernel_regularizer)),
+            name)
 
     def conv2d(self, input: Tensor, out_channels: int, kernel_h: int,
                kernel_w: int, stride_h: int, stride_w: int, padding_h: int,
@@ -717,6 +743,14 @@ class FFModel:
 
         compute_dtype = jnp.dtype(self.config.compute_dtype)
 
+        # per-layer weight regularizers (reference keras/regularizers.py):
+        # the attr is always None or a non-empty list of ("l1"|"l2", coeff)
+        # pairs (normalized + validated by _normalize_regularizer at build)
+        reg_terms = []
+        for layer in self.layers:
+            for kind, coeff in layer.attrs.get("kernel_regularizer") or []:
+                reg_terms.append((layer.name, "kernel", kind, coeff))
+
         def loss_and_out(p, feeds, label, rng, state):
             ctx = OpContext(training=True, rng=rng, compute_dtype=compute_dtype,
                             mesh=self.mesh, config=self.config)
@@ -725,6 +759,11 @@ class FFModel:
             logits = (values[self._logits_tensor.tensor_id]
                       if self._logits_tensor is not None else None)
             loss = compute_loss(self.loss_type, out, label, logits=logits)
+            for lname, wname, kind, coeff in reg_terms:
+                w = p[lname][wname]
+                pen = (jnp.sum(jnp.abs(w)) if kind == "l1"
+                       else jnp.sum(jnp.square(w)))
+                loss = loss + coeff * pen
             return loss, (out, new_state)
 
         fwd = loss_and_out

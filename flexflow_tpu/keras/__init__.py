@@ -15,11 +15,12 @@ from flexflow_tpu.keras import (
     models,
     optimizers,
     preprocessing,
+    regularizers,
     utils,
 )
 from flexflow_tpu.keras.layers import Input
 from flexflow_tpu.keras.models import Model, Sequential
 
 __all__ = ["callbacks", "datasets", "initializers", "layers", "losses",
-           "metrics", "models", "optimizers", "preprocessing", "utils",
+           "metrics", "models", "optimizers", "preprocessing", "regularizers", "utils",
            "Input", "Model", "Sequential"]

@@ -161,6 +161,7 @@ class Dense(Layer):
 
     def __init__(self, units: int, activation=None, use_bias: bool = True,
                  kernel_initializer=None, bias_initializer=None,
+                 kernel_regularizer=None,
                  name: Optional[str] = None, **kwargs):
         super().__init__(name=name, **kwargs)
         self.units = units
@@ -170,6 +171,7 @@ class Dense(Layer):
             self._weight_names = ("kernel",)
         self.kernel_initializer = kernel_initializer
         self.bias_initializer = bias_initializer
+        self.kernel_regularizer = kernel_regularizer
 
     def compute_output_shape(self, input_shapes):
         (s,) = input_shapes
@@ -182,12 +184,14 @@ class Dense(Layer):
                              f"None, got {act!r}")
         fused = _ACTIVATIONS.get(act)
         from flexflow_tpu.keras.initializers import as_core_initializer
+        from flexflow_tpu.keras.regularizers import as_attr
         x = ffmodel.dense(
             ff_inputs[0], self.units,
             activation=fused if fused is not None else ActiMode.AC_MODE_NONE,
             use_bias=self.use_bias,
             kernel_initializer=as_core_initializer(self.kernel_initializer),
             bias_initializer=as_core_initializer(self.bias_initializer),
+            kernel_regularizer=as_attr(self.kernel_regularizer),
             name=self.name)
         if act == "softmax":
             x = ffmodel.softmax(x)
