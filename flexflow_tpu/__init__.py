@@ -47,6 +47,7 @@ from flexflow_tpu.training.optimizer import AdamOptimizer, SGDOptimizer
 from flexflow_tpu.training.dataloader import SingleDataLoader
 from flexflow_tpu.training.checkpoint import (
     CheckpointManager,
+    fit_with_recovery,
     load_weights_npz,
     save_weights_npz,
 )

@@ -134,6 +134,42 @@ class CheckpointManager:
         self._mgr.close()
 
 
+def fit_with_recovery(model, x, y, epochs: int, manager: CheckpointManager,
+                      batch_size: Optional[int] = None,
+                      save_every_epochs: int = 1, shuffle: bool = False):
+    """Fault-tolerant fit: resume from the latest checkpoint and keep
+    checkpointing every ``save_every_epochs``.
+
+    The failure-recovery upgrade the reference lacks (SURVEY §5: no retry,
+    no elasticity): re-running the same command after a crash/preemption
+    restores params, optimizer and rng state, and continues from the next
+    epoch. Returns the combined history for the epochs run in THIS process.
+    """
+    if save_every_epochs < 1:
+        raise ValueError(f"save_every_epochs must be >= 1, "
+                         f"got {save_every_epochs}")
+    start_epoch = 0
+    latest = manager.latest_step()
+    if latest is not None:
+        meta = manager.restore(model)
+        epoch_meta = meta.get("extra", {}).get("epoch")
+        if epoch_meta is None:
+            raise ValueError(
+                f"checkpoint step {meta['step']} in {manager.directory} was "
+                f"not written by fit_with_recovery (no 'epoch' in extra) — "
+                f"refusing to guess the resume epoch from a batch-step id")
+        start_epoch = int(epoch_meta) + 1
+    history = []
+    for epoch in range(start_epoch, epochs):
+        recs = model.fit(x, y, batch_size=batch_size, epochs=1,
+                         shuffle=shuffle, initial_epoch=epoch)
+        history += [{**r, "epoch": epoch} for r in recs]
+        if (epoch - start_epoch) % save_every_epochs == 0 \
+                or epoch == epochs - 1:
+            manager.save(epoch, model, extra={"epoch": epoch}, force=True)
+    return history
+
+
 # ----------------------------------------------------------------------
 # Flat weight export/import — the serving-side counterpart of the reference
 # FileDataLoader (inference/file_loader.cc:757): one binary blob per weight

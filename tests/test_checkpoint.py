@@ -110,6 +110,56 @@ def test_flat_npz_weight_interchange(tmp_path):
     _params_equal(model.params, model2.params)
 
 
+def test_fit_with_recovery_resumes_identically(tmp_path):
+    """Crash-and-rerun must land at the same final weights as an unbroken
+    run (the failure-recovery upgrade the reference lacks, SURVEY §5)."""
+    import flexflow_tpu as ff
+    from flexflow_tpu.training.checkpoint import fit_with_recovery
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(128, 16).astype(np.float32)
+    y = rng.randint(0, 4, (128, 1)).astype(np.int32)
+
+    def make():
+        m = ff.FFModel(ff.FFConfig(batch_size=32, seed=9))
+        t = m.create_tensor([32, 16], ff.DataType.DT_FLOAT)
+        h = m.dense(t, 16, ff.ActiMode.AC_MODE_RELU, name="fc1")
+        m.softmax(m.dense(h, 4, name="fc2"))
+        m.compile(optimizer=ff.SGDOptimizer(m, lr=0.1),
+                  loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[])
+        return m
+
+    # unbroken run: 4 epochs straight through
+    mgr_a = ff.CheckpointManager(str(tmp_path / "a"))
+    ma = make()
+    fit_with_recovery(ma, x, y, epochs=4, manager=mgr_a)
+    want = ma.get_parameter_by_key(("fc1", "kernel"))
+
+    # interrupted run: 2 epochs, 'crash', then a fresh process resumes
+    mgr_b = ff.CheckpointManager(str(tmp_path / "b"))
+    mb = make()
+    fit_with_recovery(mb, x, y, epochs=2, manager=mgr_b)
+    del mb
+    mgr_b2 = ff.CheckpointManager(str(tmp_path / "b"))
+    mb2 = make()   # fresh init, overwritten by restore
+    hist = fit_with_recovery(mb2, x, y, epochs=4, manager=mgr_b2)
+    assert len(hist) == 2   # only epochs 2..3 ran in the resumed process
+    assert [h["epoch"] for h in hist] == [2, 3]   # global epoch numbering
+    got = mb2.get_parameter_by_key(("fc1", "kernel"))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+    # guard rails: step-based checkpoints and bad cadence are rejected
+    with pytest.raises(ValueError, match="save_every_epochs"):
+        fit_with_recovery(mb2, x, y, epochs=5, manager=mgr_b2,
+                          save_every_epochs=0)
+    mgr_c = ff.CheckpointManager(str(tmp_path / "c"))
+    mc = make()
+    mgr_c.save(5000, mc)          # raw batch-step checkpoint, no epoch
+    with pytest.raises(ValueError, match="not written by fit_with_recovery"):
+        fit_with_recovery(mc, x, y, epochs=4, manager=mgr_c)
+
+
 def test_restore_missing_raises(tmp_path):
     model = _build_model()
     mgr = ff.CheckpointManager(str(tmp_path / "empty"))
