@@ -456,6 +456,26 @@ class FFModel:
     def flat(self, input: Tensor, name=None):
         return self._add_layer(OpType.FLAT, [input], {}, name)
 
+    def slice_tensor(self, input: Tensor, starts, ends,
+                     squeeze_dims=(), name=None):
+        """Static slice; starts/ends per dim (None = full extent, negatives
+        wrap); squeeze_dims drop sliced size-1 dims (BERT's x[:, 0])."""
+        return self._add_layer(OpType.SLICE, [input], dict(
+            starts=tuple(starts), ends=tuple(ends),
+            squeeze_dims=tuple(squeeze_dims)), name)
+
+    def squeeze(self, input: Tensor, dim: int, name=None):
+        dim = dim % input.num_dims
+        assert input.dims[dim] == 1, (input.dims, dim)
+        shape = [s for d, s in enumerate(input.dims) if d != dim]
+        return self.reshape(input, shape, name=name)
+
+    def unsqueeze(self, input: Tensor, dim: int, name=None):
+        shape = list(input.dims)
+        dim = dim % (input.num_dims + 1)
+        shape.insert(dim, 1)
+        return self.reshape(input, shape, name=name)
+
     def cast(self, input: Tensor, dtype: DataType, name=None):
         return self._add_layer(OpType.CAST, [input], dict(dtype=dtype), name)
 

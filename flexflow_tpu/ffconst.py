@@ -155,6 +155,7 @@ class OpType(enum.Enum):
     CONCAT = enum.auto()
     SPLIT = enum.auto()
     RESHAPE = enum.auto()
+    SLICE = enum.auto()
     TRANSPOSE = enum.auto()
     REVERSE = enum.auto()
     FLAT = enum.auto()

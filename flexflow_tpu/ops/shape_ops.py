@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from flexflow_tpu.ffconst import DataType, OpType
@@ -131,3 +132,44 @@ class Cast(OpImpl):
     @staticmethod
     def forward(attrs, params, inputs, ctx):
         return [inputs[0].astype(attrs["dtype"].to_jnp())]
+
+
+@register_op
+class Slice(OpImpl):
+    """Static strided slice (no reference twin op — the reference's
+    frontends avoid slicing; needed here for torch.fx graphs like BERT's
+    ``x[:, 0]`` CLS extraction). starts/ends are per-dim (ends exclusive;
+    None -> full extent); squeeze_dims drop size-1 sliced dims."""
+
+    op_type = OpType.SLICE
+
+    @staticmethod
+    def _resolve(attrs, shape):
+        starts, ends = [], []
+        for d, size in enumerate(shape):
+            s, e = (attrs["starts"][d], attrs["ends"][d]) \
+                if d < len(attrs["starts"]) else (None, None)
+            s = 0 if s is None else (s + size if s < 0 else s)
+            e = size if e is None else (e + size if e < 0 else e)
+            starts.append(max(0, min(s, size)))
+            ends.append(max(starts[-1], min(e, size)))
+        return starts, ends
+
+    @staticmethod
+    def infer_output_specs(attrs, input_specs):
+        (shape, dtype) = input_specs[0]
+        starts, ends = Slice._resolve(attrs, shape)
+        out = [e - s for s, e in zip(starts, ends)]
+        squeeze = set(attrs.get("squeeze_dims", ()))
+        out = [n for d, n in enumerate(out) if d not in squeeze]
+        return [(tuple(out), dtype)]
+
+    @staticmethod
+    def forward(attrs, params, inputs, ctx):
+        x = inputs[0]
+        starts, ends = Slice._resolve(attrs, x.shape)
+        y = jax.lax.slice(x, starts, ends)
+        squeeze = sorted(set(attrs.get("squeeze_dims", ())), reverse=True)
+        for d in squeeze:
+            y = jnp.squeeze(y, axis=d)
+        return [y]

@@ -75,6 +75,9 @@ class PyTorchModel:
         self.module = module
         self.seq_length = seq_length
         self.traced = torch.fx.symbolic_trace(module)
+        # drop dead nodes (e.g. the unused getitem(mha, 1) a tuple unpack
+        # `out, _ = mha(...)` leaves behind)
+        self.traced.graph.eliminate_dead_code()
         self._ir: Optional[List[IRNode]] = None
 
     # ------------------------------------------------------------------
@@ -85,6 +88,13 @@ class PyTorchModel:
             return self._ir
         ir: List[IRNode] = []
         mods = dict(self.traced.named_modules())
+        # fx nodes whose *torch* value is a tuple even though our lowering
+        # yields one tensor (MultiheadAttention -> (out, weights)):
+        # getitem(n, 0) must select the tuple element, not slice a tensor
+        self._tuple_nodes = {
+            n.name for n in self.traced.graph.nodes
+            if n.op == "call_module"
+            and isinstance(mods.get(n.target), nn.MultiheadAttention)}
         placeholders = 0
         for node in self.traced.graph.nodes:
             ins = [a.name for a in node.args
@@ -214,6 +224,32 @@ class PyTorchModel:
         if t is torch.permute:
             return IRNode("permute", name, ins,
                           {"perm": [int(p) for p in node.args[1]]})
+        if t is operator.getitem:
+            src = node.args[0]
+            if isinstance(src, torch.fx.Node) \
+                    and src.name in getattr(self, "_tuple_nodes", ()):
+                if node.args[1] != 0:
+                    raise NotImplementedError(
+                        "only the output tensor (index 0) of "
+                        "MultiheadAttention is available")
+                return IRNode("identity", name, ins, {})
+            return IRNode("getitem", name, ins,
+                          {"index": _serialize_index(node.args[1])})
+        if t is torch.softmax:
+            return IRNode("softmax", name, ins,
+                          {"axis": node.kwargs.get(
+                              "dim", scalars[0] if scalars else -1)})
+        if t is torch.mean:
+            dim = node.kwargs.get("dim",
+                                  scalars[0] if scalars else None)
+            if dim is None:
+                raise NotImplementedError("full-tensor torch.mean")
+            keepdim = node.kwargs.get(
+                "keepdim", scalars[1] if len(scalars) > 1 else False)
+            return IRNode("mean", name, ins,
+                          {"dims": [int(dim)] if isinstance(dim, int)
+                           else [int(d) for d in dim],
+                           "keepdims": bool(keepdim)})
         if t is getattr:
             raise NotImplementedError("getattr on tensors not supported")
         raise NotImplementedError(f"function {t}")
@@ -244,6 +280,26 @@ class PyTorchModel:
         if m == "softmax":
             return IRNode("softmax", name, ins,
                           {"axis": node.kwargs.get("dim", -1)})
+        if m == "mean":
+            dim = node.kwargs.get("dim",
+                                  node.args[1] if len(node.args) > 1
+                                  else None)
+            if dim is None:
+                raise NotImplementedError("full-tensor .mean()")
+            keepdim = node.kwargs.get(
+                "keepdim", node.args[2] if len(node.args) > 2 else False)
+            return IRNode("mean", name, ins,
+                          {"dims": [int(dim)] if isinstance(dim, int)
+                           else [int(d) for d in dim],
+                           "keepdims": bool(keepdim)})
+        if m in ("unsqueeze", "squeeze"):
+            dim = node.kwargs.get("dim",
+                                  node.args[1] if len(node.args) > 1
+                                  else None)
+            if dim is None:
+                raise NotImplementedError(
+                    f".{m}() without a dim (squeeze-all is unsupported)")
+            return IRNode(m, name, ins, {"dim": int(dim)})
         raise NotImplementedError(f"method {m}")
 
     # ------------------------------------------------------------------
@@ -288,6 +344,29 @@ class PyTorchModel:
                 if mod.bias is not None:
                     ffmodel.set_parameter_by_key(
                         (name, "beta"), mod.bias.detach().numpy().copy())
+
+
+def _serialize_index(idx) -> List[Dict[str, Any]]:
+    """fx getitem index -> JSON-able per-dim records."""
+    items = idx if isinstance(idx, tuple) else (idx,)
+    out: List[Dict[str, Any]] = []
+    for it in items:
+        if it is Ellipsis:
+            raise NotImplementedError("Ellipsis indexing")
+        if isinstance(it, slice):
+            if it.step not in (None, 1):
+                raise NotImplementedError("strided slicing")
+            for bound in (it.start, it.stop):
+                if bound is not None and not isinstance(bound, int):
+                    raise NotImplementedError(
+                        f"dynamic slice bound {bound!r} (traced values "
+                        f"cannot be static slice extents)")
+            out.append({"kind": "slice", "start": it.start, "stop": it.stop})
+        elif isinstance(it, int):
+            out.append({"kind": "int", "index": it})
+        else:
+            raise NotImplementedError(f"index element {it!r}")
+    return out
 
 
 def file_to_ff(filename: str, ffmodel, input_tensors: Sequence,
@@ -396,6 +475,27 @@ def ir_to_ff(ir: List[IRNode], ffmodel, input_tensors: Sequence,
             out = ffmodel.transpose(ins[0], perm, name=n.name)
         elif n.op == "batch_matmul":
             out = ffmodel.batch_matmul(ins[0], ins[1], name=n.name)
+        elif n.op == "getitem":
+            nd = ins[0].num_dims
+            starts = [None] * nd
+            ends = [None] * nd
+            squeeze = []
+            for d, rec in enumerate(a["index"]):
+                if rec["kind"] == "int":
+                    k = rec["index"]
+                    starts[d], ends[d] = k, (None if k == -1 else k + 1)
+                    squeeze.append(d)
+                else:
+                    starts[d], ends[d] = rec["start"], rec["stop"]
+            out = ffmodel.slice_tensor(ins[0], starts, ends,
+                                       squeeze_dims=squeeze, name=n.name)
+        elif n.op == "mean":
+            out = ffmodel.mean(ins[0], dims=a["dims"],
+                               keepdims=a.get("keepdims", False), name=n.name)
+        elif n.op == "unsqueeze":
+            out = ffmodel.unsqueeze(ins[0], a["dim"], name=n.name)
+        elif n.op == "squeeze":
+            out = ffmodel.squeeze(ins[0], a["dim"], name=n.name)
         else:
             raise NotImplementedError(f"IR op {n.op}")
         env[n.name] = out

@@ -95,6 +95,81 @@ def test_reversed_scalar_ops_alignment():
     _align(ReversedScalars(), x, 8)
 
 
+class BertPooler(nn.Module):
+    """BERT-style block: embedding, layernorm, CLS slice + mean pooling,
+    concat, unsqueeze/squeeze round-trip, softmax head."""
+
+    def __init__(self):
+        super().__init__()
+        self.emb = nn.Embedding(100, 32)
+        self.ln = nn.LayerNorm(32)
+        self.fc = nn.Linear(64, 8)
+
+    def forward(self, ids):
+        x = self.ln(self.emb(ids))
+        cls = x[:, 0]
+        pooled = x.mean(dim=1)
+        z = torch.cat([cls, pooled], dim=-1)
+        z = z.unsqueeze(1).squeeze(1)
+        return torch.softmax(self.fc(z), dim=-1)
+
+
+def test_bert_pooler_alignment():
+    module = BertPooler()
+    pt = PyTorchModel(module)
+    model = ff.FFModel(ff.FFConfig(batch_size=8))
+    t = model.create_tensor([8, 12], ff.DataType.DT_INT32)
+    outs = pt.torch_to_ff(model, [t])
+    assert len(outs) == 1
+    model.compile()
+    pt.copy_weights(model)
+    ids = np.random.RandomState(5).randint(0, 100, (8, 12)).astype(np.int32)
+    got = model.predict(ids)
+    with torch.no_grad():
+        want = module(torch.from_numpy(ids.astype(np.int64))).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+class MhaTupleIndex(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.mha = nn.MultiheadAttention(16, 4, batch_first=True)
+        self.fc = nn.Linear(16, 4)
+
+    def forward(self, x):
+        out, _ = self.mha(x, x, x)       # tuple unpack via getitem 0
+        return self.fc(out.mean(1, True)).squeeze(dim=1)
+
+
+def test_mha_tuple_getitem_and_positional_keepdim():
+    """getitem on a tuple-valued module selects the element (not a tensor
+    slice); positional keepdim and keyword squeeze(dim=) are honored."""
+    pt = PyTorchModel(MhaTupleIndex())
+    model = ff.FFModel(ff.FFConfig(batch_size=4))
+    t = model.create_tensor([4, 6, 16], ff.DataType.DT_FLOAT)
+    outs = pt.torch_to_ff(model, [t])
+    assert outs[0].dims == (4, 4)
+    model.compile()
+    x = np.random.RandomState(7).randn(4, 6, 16).astype(np.float32)
+    assert model.predict(x).shape == (4, 4)
+
+
+def test_slice_op_semantics():
+    model = ff.FFModel(ff.FFConfig(batch_size=4))
+    t = model.create_tensor([4, 6, 8], ff.DataType.DT_FLOAT)
+    s = model.slice_tensor(t, [None, 1, 2], [None, 4, -1])
+    assert s.dims == (4, 3, 5)
+    c = model.slice_tensor(t, [None, 0, None], [None, 1, None],
+                           squeeze_dims=[1])
+    model.concat([model.flat(s), c], axis=1)
+    model.compile()
+    x = np.random.RandomState(0).randn(4, 6, 8).astype(np.float32)
+    got = model.predict(x)
+    want = np.concatenate(
+        [x[:, 1:4, 2:-1].reshape(4, -1), x[:, 0, :]], axis=1)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
 def test_file_ir_roundtrip(tmp_path):
     module = MLP()
     pt = PyTorchModel(module)
