@@ -1,0 +1,65 @@
+"""ResNeXt-50-style trainer (reference examples/cpp/resnext50/resnext.cc):
+bottleneck blocks with grouped 3x3 convolutions (cardinality).
+Scaled-down stage widths by default so it runs anywhere.
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu as ff
+
+CARDINALITY = 8
+
+
+def resnext_block(model, x, mid, out_ch, stride):
+    """1x1 reduce -> grouped 3x3 (cardinality groups) -> 1x1 expand +
+    shortcut (reference resnext.cc resnext_block)."""
+    shortcut = x
+    y = model.conv2d(x, mid, 1, 1, 1, 1, 0, 0)
+    y = model.batch_norm(y, relu=True)
+    y = model.conv2d(y, mid, 3, 3, stride, stride, 1, 1,
+                     groups=CARDINALITY)
+    y = model.batch_norm(y, relu=True)
+    y = model.conv2d(y, out_ch, 1, 1, 1, 1, 0, 0)
+    y = model.batch_norm(y, relu=False)
+    if stride != 1 or x.dims[1] != out_ch:
+        shortcut = model.conv2d(x, out_ch, 1, 1, stride, stride, 0, 0)
+        shortcut = model.batch_norm(shortcut, relu=False)
+    return model.relu(model.add(y, shortcut))
+
+
+def top_level_task(n_samples=64):
+    config = ff.FFConfig.from_args()
+    config.batch_size = min(config.batch_size, n_samples)
+    model = ff.FFModel(config)
+    t = model.create_tensor([config.batch_size, 3, 32, 32],
+                            ff.DataType.DT_FLOAT)
+    x = model.conv2d(t, 32, 3, 3, 1, 1, 1, 1)
+    x = model.batch_norm(x, relu=True)
+    for mid, out_ch, stride in [(32, 64, 1), (32, 64, 1),
+                                (64, 128, 2), (64, 128, 1)]:
+        x = resnext_block(model, x, mid, out_ch, stride)
+    x = model.pool2d(x, 16, 16, 1, 1, 0, 0, ff.PoolType.POOL_AVG)
+    x = model.flat(x)
+    x = model.dense(x, 10)
+    model.softmax(x)
+
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=config.learning_rate,
+                                  momentum=0.9),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY])
+
+    rng = np.random.RandomState(config.seed)
+    xs = rng.randn(n_samples, 3, 32, 32).astype(np.float32)
+    ys = rng.randint(0, 10, size=(n_samples, 1)).astype(np.int32)
+    model.fit(xs, ys, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

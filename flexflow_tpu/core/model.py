@@ -166,13 +166,16 @@ class FFModel:
                padding_w: int, activation: ActiMode = ActiMode.AC_MODE_NONE,
                groups: int = 1, use_bias: bool = True,
                kernel_initializer=None, bias_initializer=None,
+               kernel_regularizer=None,
                name: Optional[str] = None) -> Tensor:
         return self._add_layer(OpType.CONV2D, [input], dict(
             out_channels=out_channels, kernel_h=kernel_h, kernel_w=kernel_w,
             stride_h=stride_h, stride_w=stride_w, padding_h=padding_h,
             padding_w=padding_w, activation=activation, groups=groups,
             use_bias=use_bias, kernel_initializer=kernel_initializer,
-            bias_initializer=bias_initializer), name)
+            bias_initializer=bias_initializer,
+            kernel_regularizer=_normalize_regularizer(kernel_regularizer)),
+            name)
 
     def pool2d(self, input: Tensor, kernel_h: int, kernel_w: int,
                stride_h: int, stride_w: int, padding_h: int, padding_w: int,

@@ -320,7 +320,8 @@ class Conv2D(Layer):
     def __init__(self, filters: int, kernel_size, strides=(1, 1),
                  padding="valid", activation=None, use_bias: bool = True,
                  groups: int = 1, kernel_initializer=None,
-                 bias_initializer=None, name: Optional[str] = None, **kwargs):
+                 bias_initializer=None, kernel_regularizer=None,
+                 name: Optional[str] = None, **kwargs):
         super().__init__(name=name, **kwargs)
         self.filters = filters
         self.kernel_size = _pair(kernel_size)
@@ -333,6 +334,7 @@ class Conv2D(Layer):
         self.groups = groups
         self.kernel_initializer = kernel_initializer
         self.bias_initializer = bias_initializer
+        self.kernel_regularizer = kernel_regularizer
 
     def compute_output_shape(self, input_shapes):
         (s,) = input_shapes
@@ -354,12 +356,14 @@ class Conv2D(Layer):
                              f"None, got {act!r}")
         fused = _ACTIVATIONS.get(act)
         from flexflow_tpu.keras.initializers import as_core_initializer
+        from flexflow_tpu.keras.regularizers import as_attr
         x = ffmodel.conv2d(
             ff_inputs[0], self.filters, kh, kw, sh, sw, ph, pw,
             activation=fused if fused is not None else ActiMode.AC_MODE_NONE,
             groups=self.groups, use_bias=self.use_bias,
             kernel_initializer=as_core_initializer(self.kernel_initializer),
             bias_initializer=as_core_initializer(self.bias_initializer),
+            kernel_regularizer=as_attr(self.kernel_regularizer),
             name=self.name)
         if fused is None and act is not None:
             raise ValueError(f"unsupported activation {act!r}")

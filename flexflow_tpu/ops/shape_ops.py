@@ -161,6 +161,13 @@ class Slice(OpImpl):
         starts, ends = Slice._resolve(attrs, shape)
         out = [e - s for s, e in zip(starts, ends)]
         squeeze = set(attrs.get("squeeze_dims", ()))
+        for d in squeeze:
+            if out[d] != 1:
+                # an out-of-range int index clamps to an empty extent —
+                # surface it at build time like Python's IndexError would
+                raise IndexError(
+                    f"slice squeeze dim {d} has extent {out[d]} "
+                    f"(start={attrs['starts'][d]} on size {shape[d]})")
         out = [n for d, n in enumerate(out) if d not in squeeze]
         return [(tuple(out), dtype)]
 

@@ -202,9 +202,10 @@ class PyTorchModel:
             return IRNode("tanh", name, ins, {})
         if t is F.gelu:
             return IRNode("gelu", name, ins, {})
-        if t is F.softmax:
+        if t in (F.softmax, torch.softmax):
             return IRNode("softmax", name, ins,
-                          {"axis": node.kwargs.get("dim", -1)})
+                          {"axis": node.kwargs.get(
+                              "dim", scalars[0] if scalars else -1)})
         if t is torch.flatten:
             return IRNode("flat", name, ins, {})
         if t is F.dropout:
@@ -235,21 +236,9 @@ class PyTorchModel:
                 return IRNode("identity", name, ins, {})
             return IRNode("getitem", name, ins,
                           {"index": _serialize_index(node.args[1])})
-        if t is torch.softmax:
-            return IRNode("softmax", name, ins,
-                          {"axis": node.kwargs.get(
-                              "dim", scalars[0] if scalars else -1)})
         if t is torch.mean:
-            dim = node.kwargs.get("dim",
-                                  scalars[0] if scalars else None)
-            if dim is None:
-                raise NotImplementedError("full-tensor torch.mean")
-            keepdim = node.kwargs.get(
-                "keepdim", scalars[1] if len(scalars) > 1 else False)
             return IRNode("mean", name, ins,
-                          {"dims": [int(dim)] if isinstance(dim, int)
-                           else [int(d) for d in dim],
-                           "keepdims": bool(keepdim)})
+                          _mean_attrs(node.kwargs, scalars))
         if t is getattr:
             raise NotImplementedError("getattr on tensors not supported")
         raise NotImplementedError(f"function {t}")
@@ -279,19 +268,12 @@ class PyTorchModel:
             return IRNode(m, name, ins, {})
         if m == "softmax":
             return IRNode("softmax", name, ins,
-                          {"axis": node.kwargs.get("dim", -1)})
+                          {"axis": node.kwargs.get(
+                              "dim", node.args[1] if len(node.args) > 1
+                              else -1)})
         if m == "mean":
-            dim = node.kwargs.get("dim",
-                                  node.args[1] if len(node.args) > 1
-                                  else None)
-            if dim is None:
-                raise NotImplementedError("full-tensor .mean()")
-            keepdim = node.kwargs.get(
-                "keepdim", node.args[2] if len(node.args) > 2 else False)
             return IRNode("mean", name, ins,
-                          {"dims": [int(dim)] if isinstance(dim, int)
-                           else [int(d) for d in dim],
-                           "keepdims": bool(keepdim)})
+                          _mean_attrs(node.kwargs, list(node.args[1:])))
         if m in ("unsqueeze", "squeeze"):
             dim = node.kwargs.get("dim",
                                   node.args[1] if len(node.args) > 1
@@ -344,6 +326,19 @@ class PyTorchModel:
                 if mod.bias is not None:
                     ffmodel.set_parameter_by_key(
                         (name, "beta"), mod.bias.detach().numpy().copy())
+
+
+def _mean_attrs(kwargs, positional) -> Dict[str, Any]:
+    """Shared dim/keepdim extraction for torch.mean / Tensor.mean
+    (dim and keepdim may each be positional or keyword)."""
+    dim = kwargs.get("dim", positional[0] if positional else None)
+    if dim is None:
+        raise NotImplementedError("full-tensor mean")
+    keepdim = kwargs.get("keepdim",
+                         positional[1] if len(positional) > 1 else False)
+    return {"dims": [int(dim)] if isinstance(dim, int)
+            else [int(d) for d in dim],
+            "keepdims": bool(keepdim)}
 
 
 def _serialize_index(idx) -> List[Dict[str, Any]]:
@@ -495,7 +490,11 @@ def ir_to_ff(ir: List[IRNode], ffmodel, input_tensors: Sequence,
         elif n.op == "unsqueeze":
             out = ffmodel.unsqueeze(ins[0], a["dim"], name=n.name)
         elif n.op == "squeeze":
-            out = ffmodel.squeeze(ins[0], a["dim"], name=n.name)
+            d = a["dim"] % ins[0].num_dims
+            if ins[0].dims[d] != 1:   # torch: no-op on non-size-1 dims
+                out = ins[0]
+            else:
+                out = ffmodel.squeeze(ins[0], d, name=n.name)
         else:
             raise NotImplementedError(f"IR op {n.op}")
         env[n.name] = out

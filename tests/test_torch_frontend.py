@@ -42,6 +42,21 @@ class CNN(nn.Module):
         return self.fc(x)
 
 
+class GroupedConv(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv = nn.Conv2d(8, 16, 3, groups=4, padding=1)
+
+    def forward(self, x):
+        return torch.relu(self.conv(x))
+
+
+def test_grouped_conv_alignment():
+    """Grouped convolution (ResNeXt cardinality) matches torch exactly."""
+    x = np.random.RandomState(6).randn(4, 8, 10, 10).astype(np.float32)
+    _align(GroupedConv(), x, 4)
+
+
 class ResidualBlock(nn.Module):
     def __init__(self):
         super().__init__()
@@ -152,6 +167,31 @@ def test_mha_tuple_getitem_and_positional_keepdim():
     model.compile()
     x = np.random.RandomState(7).randn(4, 6, 16).astype(np.float32)
     assert model.predict(x).shape == (4, 4)
+
+
+class EdgeSemantics(nn.Module):
+    def forward(self, x):                      # x: [B, 3, 4]
+        a = x.softmax(1)                       # positional softmax dim
+        b = a.squeeze(dim=1)                   # no-op (size 3 != 1)
+        return b.mean(-1, True).squeeze(2)     # positional keepdim + squeeze
+
+
+def test_positional_softmax_and_noop_squeeze():
+    module = EdgeSemantics()
+    x = np.random.RandomState(8).randn(4, 3, 4).astype(np.float32)
+    _align(module, x, 4)
+
+
+def test_out_of_range_index_raises_at_build():
+    class Bad(nn.Module):
+        def forward(self, x):
+            return x[:, 50]
+
+    pt = PyTorchModel(Bad())
+    model = ff.FFModel(ff.FFConfig(batch_size=2))
+    t = model.create_tensor([2, 12, 4], ff.DataType.DT_FLOAT)
+    with pytest.raises(IndexError, match="squeeze dim"):
+        pt.torch_to_ff(model, [t])
 
 
 def test_slice_op_semantics():
