@@ -1,0 +1,44 @@
+"""CIFAR-10 CNN with concatenated parallel branches (reference
+examples/python/native/cifar10_cnn_concat.py)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.keras.datasets import cifar10
+
+
+def top_level_task():
+    config = ff.FFConfig.from_args()
+    model = ff.FFModel(config)
+    t = model.create_tensor([config.batch_size, 3, 32, 32],
+                            ff.DataType.DT_FLOAT)
+    b1 = model.conv2d(t, 32, 3, 3, 1, 1, 1, 1, ff.ActiMode.AC_MODE_RELU)
+    b2 = model.conv2d(t, 32, 5, 5, 1, 1, 2, 2, ff.ActiMode.AC_MODE_RELU)
+    x = model.concat([b1, b2], axis=1)
+    x = model.pool2d(x, 2, 2, 2, 2, 0, 0)
+    x = model.conv2d(x, 64, 3, 3, 1, 1, 1, 1, ff.ActiMode.AC_MODE_RELU)
+    x = model.pool2d(x, 2, 2, 2, 2, 0, 0)
+    x = model.flat(x)
+    x = model.dense(x, 256, ff.ActiMode.AC_MODE_RELU)
+    x = model.dense(x, 10)
+    model.softmax(x)
+
+    model.compile(
+        optimizer=ff.AdamOptimizer(model, alpha=1e-3),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY])
+
+    (x_train, y_train), _ = cifar10.load_data(n_train=2048)
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+    model.fit(x_train, y_train, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,36 @@
+"""Gather-op demo (reference examples/python/native/demo_gather.py):
+index-select rows of a projected table with the gather operator."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu as ff
+
+
+def top_level_task():
+    config = ff.FFConfig.from_args()
+    B, S, D = config.batch_size, 16, 32
+    model = ff.FFModel(config)
+    data = model.create_tensor([B, S, D], ff.DataType.DT_FLOAT)
+    index = model.create_tensor([B, 4, D], ff.DataType.DT_INT64)
+    g = model.gather(data, index, dim=1)
+    x = model.flat(g)
+    x = model.dense(x, 8)
+    model.softmax(x)
+    model.compile()
+
+    rng = np.random.RandomState(config.seed)
+    xs = rng.randn(B, S, D).astype(np.float32)
+    idx = np.broadcast_to(
+        rng.randint(0, S, size=(B, 4, 1)), (B, 4, D)).astype(np.int64)
+    out = model.predict([xs, idx])
+    print("gather demo output:", out.shape)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,49 @@
+"""Manual train loop with SingleDataLoader (reference
+examples/python/native/mnist_mlp_attach.py: attach numpy arrays to tensors
+and drive forward/backward/update per batch instead of fit())."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.keras.datasets import mnist
+
+
+def top_level_task():
+    config = ff.FFConfig.from_args()
+    model = ff.FFModel(config)
+    t = model.create_tensor([config.batch_size, 784], ff.DataType.DT_FLOAT)
+    x = model.dense(t, 256, ff.ActiMode.AC_MODE_RELU)
+    x = model.dense(x, 10)
+    model.softmax(x)
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=config.learning_rate),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY])
+
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 784).astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    # attach the full dataset once; per-iteration sharded batch copies
+    # (reference SingleDataLoader semantics)
+    loader_x = ff.SingleDataLoader(model, t, x_train)
+    for epoch in range(config.epochs):
+        model.reset_metrics()
+        loader_x.reset()
+        for i in range(loader_x.num_batches):
+            xb = np.asarray(loader_x.next_batch())
+            yb = y_train[i * config.batch_size:(i + 1) * config.batch_size]
+            model.forward([xb])
+            model.backward()
+            model.update(yb)
+        print(f"epoch {epoch}: {model.perf_metrics.report()}")
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -355,9 +355,13 @@ class Conv2D(Layer):
             raise ValueError(f"{self.name}: activation must be a string or "
                              f"None, got {act!r}")
         fused = _ACTIVATIONS.get(act)
+        if fused is None and act is not None:
+            # validate BEFORE adding the layer so a caught error leaves no
+            # ghost layer in the model graph
+            raise ValueError(f"unsupported activation {act!r}")
         from flexflow_tpu.keras.initializers import as_core_initializer
         from flexflow_tpu.keras.regularizers import as_attr
-        x = ffmodel.conv2d(
+        return ffmodel.conv2d(
             ff_inputs[0], self.filters, kh, kw, sh, sw, ph, pw,
             activation=fused if fused is not None else ActiMode.AC_MODE_NONE,
             groups=self.groups, use_bias=self.use_bias,
@@ -365,9 +369,6 @@ class Conv2D(Layer):
             bias_initializer=as_core_initializer(self.bias_initializer),
             kernel_regularizer=as_attr(self.kernel_regularizer),
             name=self.name)
-        if fused is None and act is not None:
-            raise ValueError(f"unsupported activation {act!r}")
-        return x
 
 
 class _Pooling2D(Layer):
