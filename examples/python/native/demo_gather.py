@@ -17,7 +17,7 @@ def top_level_task():
     B, S, D = config.batch_size, 16, 32
     model = ff.FFModel(config)
     data = model.create_tensor([B, S, D], ff.DataType.DT_FLOAT)
-    index = model.create_tensor([B, 4, D], ff.DataType.DT_INT64)
+    index = model.create_tensor([B, 4, D], ff.DataType.DT_INT32)
     g = model.gather(data, index, dim=1)
     x = model.flat(g)
     x = model.dense(x, 8)
@@ -27,7 +27,7 @@ def top_level_task():
     rng = np.random.RandomState(config.seed)
     xs = rng.randn(B, S, D).astype(np.float32)
     idx = np.broadcast_to(
-        rng.randint(0, S, size=(B, 4, 1)), (B, 4, D)).astype(np.int64)
+        rng.randint(0, S, size=(B, 4, 1)), (B, 4, D)).astype(np.int32)
     out = model.predict([xs, idx])
     print("gather demo output:", out.shape)
 

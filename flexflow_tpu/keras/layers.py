@@ -183,6 +183,10 @@ class Dense(Layer):
             raise ValueError(f"{self.name}: activation must be a string or "
                              f"None, got {act!r}")
         fused = _ACTIVATIONS.get(act)
+        if fused is None and act not in (None, "softmax", "elu"):
+            # validate BEFORE adding the layer so a caught error leaves no
+            # ghost layer in the model graph (same rule as Conv2D)
+            raise ValueError(f"unsupported activation {act!r}")
         from flexflow_tpu.keras.initializers import as_core_initializer
         from flexflow_tpu.keras.regularizers import as_attr
         x = ffmodel.dense(
@@ -197,8 +201,6 @@ class Dense(Layer):
             x = ffmodel.softmax(x)
         elif act == "elu":
             x = ffmodel.elu(x)
-        elif fused is None and act is not None:
-            raise ValueError(f"unsupported activation {act!r}")
         return x
 
 

@@ -57,6 +57,27 @@ def test_llm_with_ssm_spec_infer(hf_llama):
     assert spec.output_tokens == incr.output_tokens
 
 
+def test_cli_main_incr_and_spec(capsys):
+    """python -m flexflow_tpu.serve (launcher parity): incremental and
+    speculative paths run end-to-end from argv."""
+    from flexflow_tpu.serve.__main__ import main
+
+    assert main(["--max-new-tokens", "6", "--max-seq-length", "64",
+                 "--max-tokens-per-batch", "16"]) == 0
+    out = capsys.readouterr().out
+    assert "tok/s" in out and "guid=" in out
+
+    # '--ssm-model builtin' with no --model uses the built-in draft pair;
+    # a real path without --model is rejected up front
+    assert main(["--max-new-tokens", "6", "--max-seq-length", "64",
+                 "--max-tokens-per-batch", "16",
+                 "--ssm-model", "builtin"]) == 0
+    out = capsys.readouterr().out
+    assert "[speculative]" in out
+    with pytest.raises(SystemExit):
+        main(["--ssm-model", "/some/real/draft"])
+
+
 def test_init_maps_reference_keys():
     out = ff_serve.init(num_gpus=4, memory_per_gpu=14000,
                         zero_copy_memory_per_node=30000,
