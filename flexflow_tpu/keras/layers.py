@@ -305,11 +305,25 @@ def _pair(v):
     return (v, v) if isinstance(v, int) else tuple(v)
 
 
-def _conv_padding(padding, kh, kw):
+def _conv_padding(padding, kh, kw, sh=1, sw=1, h=None, w=None):
     if padding == "valid":
         return 0, 0
     if padding == "same":
-        return kh // 2, kw // 2
+        # Keras SAME pads to output ceil(size/stride), splitting the total
+        # pad as (total//2, total - total//2) with the extra row/col at the
+        # bottom/right. The symmetric-(ph, pw) builder can express exactly
+        # the even-total cases; an odd total would silently shift every
+        # window by one pixel, so reject it instead.
+        def same_pad(size, k, s, axis):
+            total = max((-(-size // s) - 1) * s + k - size, 0)
+            if total % 2:
+                raise NotImplementedError(
+                    f"padding='same' with kernel {k}, stride {s} on "
+                    f"{axis}={size} needs asymmetric padding "
+                    f"({total // 2}, {total - total // 2}); use explicit "
+                    "(ph, pw) padding instead")
+            return total // 2
+        return same_pad(h, kh, sh, "height"), same_pad(w, kw, sw, "width")
     return _pair(padding)
 
 
@@ -343,7 +357,7 @@ class Conv2D(Layer):
         _, c, h, w = s
         kh, kw = self.kernel_size
         sh, sw = self.strides
-        ph, pw = _conv_padding(self.padding, kh, kw)
+        ph, pw = _conv_padding(self.padding, kh, kw, sh, sw, h, w)
         oh = (h + 2 * ph - kh) // sh + 1
         ow = (w + 2 * pw - kw) // sw + 1
         return (None, self.filters, oh, ow)
@@ -351,7 +365,8 @@ class Conv2D(Layer):
     def build_ff(self, ffmodel, ff_inputs):
         kh, kw = self.kernel_size
         sh, sw = self.strides
-        ph, pw = _conv_padding(self.padding, kh, kw)
+        _, _, h, w = ff_inputs[0].dims
+        ph, pw = _conv_padding(self.padding, kh, kw, sh, sw, h, w)
         act = self.activation
         if act is not None and not isinstance(act, str):
             raise ValueError(f"{self.name}: activation must be a string or "
@@ -388,14 +403,15 @@ class _Pooling2D(Layer):
         _, c, h, w = s
         kh, kw = self.pool_size
         sh, sw = self.strides
-        ph, pw = _conv_padding(self.padding, kh, kw)
+        ph, pw = _conv_padding(self.padding, kh, kw, sh, sw, h, w)
         return (None, c, (h + 2 * ph - kh) // sh + 1,
                 (w + 2 * pw - kw) // sw + 1)
 
     def build_ff(self, ffmodel, ff_inputs):
         kh, kw = self.pool_size
         sh, sw = self.strides
-        ph, pw = _conv_padding(self.padding, kh, kw)
+        _, _, h, w = ff_inputs[0].dims
+        ph, pw = _conv_padding(self.padding, kh, kw, sh, sw, h, w)
         return ffmodel.pool2d(ff_inputs[0], kh, kw, sh, sw, ph, pw,
                               pool_type=self.pool_type, name=self.name)
 

@@ -69,6 +69,26 @@ class ONNXModel:
         def data(i):
             return env[node.inputs[i]]
 
+        def conv_pads():
+            # ONNX pads are [top, left, bottom, right]; the builder takes one
+            # (ph, pw) pair, so asymmetric padding cannot be represented.
+            auto_pad = _attr(node, "auto_pad", b"NOTSET")
+            if isinstance(auto_pad, bytes):
+                auto_pad = auto_pad.decode()
+            if auto_pad == "":          # protobuf string default == NOTSET
+                auto_pad = "NOTSET"
+            if auto_pad not in ("NOTSET", "VALID"):
+                raise NotImplementedError(
+                    f"{op} auto_pad={auto_pad!r} is not supported "
+                    "(only NOTSET/VALID)")
+            pads = _attr(node, "pads", [0, 0, 0, 0])
+            if auto_pad == "VALID":
+                return [0, 0]
+            if pads[0] != pads[2] or pads[1] != pads[3]:
+                raise NotImplementedError(
+                    f"{op} asymmetric pads {pads} are not supported")
+            return [pads[0], pads[1]]
+
         if op == "Gemm":
             w = init[node.inputs[1]]
             trans_b = _attr(node, "transB", 0)
@@ -93,7 +113,7 @@ class ONNXModel:
             w = init[node.inputs[1]]
             kh, kw = _attr(node, "kernel_shape", list(w.shape[2:]))
             sh, sw = _attr(node, "strides", [1, 1])
-            pads = _attr(node, "pads", [0, 0, 0, 0])
+            pads = conv_pads()
             groups = _attr(node, "group", 1)
             use_bias = len(node.inputs) > 2
             t = ff.conv2d(data(0), int(w.shape[0]), int(kh), int(kw),
@@ -108,7 +128,7 @@ class ONNXModel:
         elif op in ("MaxPool", "AveragePool"):
             kh, kw = _attr(node, "kernel_shape")
             sh, sw = _attr(node, "strides", [kh, kw])
-            pads = _attr(node, "pads", [0, 0, 0, 0])
+            pads = conv_pads()
             pool = PoolType.POOL_MAX if op == "MaxPool" else PoolType.POOL_AVG
             t = ff.pool2d(data(0), int(kh), int(kw), int(sh), int(sw),
                           int(pads[0]), int(pads[1]), pool_type=pool,

@@ -424,6 +424,17 @@ class RequestManager:
                                 node_parent.append(cur)
                                 child = len(node_tok) - 1
                             cur = child
+                    # Each chain is clamped to `room`, but the MERGED tree can
+                    # hold up to 1 + n_ssms*room nodes, and node j is staged at
+                    # cache[start + j]: without this cap, divergent chains near
+                    # the sequence limit write tree KV past max_seq (dropped by
+                    # append_kv) and verify against a clipped cache. Parents
+                    # always precede children, so truncating the suffix keeps
+                    # a valid tree.
+                    cap = max_seq - (len(req.tokens) - 1)
+                    if len(node_tok) > cap:
+                        node_tok = node_tok[:cap]
+                        node_parent = node_parent[:cap]
                     trees[req.slot] = (node_tok, node_parent)
                 # ---- verify on the LLM ----
                 self._verify_and_commit(llm, llm_ifm, live, trees, R, T,

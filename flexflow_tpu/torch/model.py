@@ -166,6 +166,13 @@ class PyTorchModel:
                 "num_entries": mod.num_embeddings,
                 "out_dim": mod.embedding_dim})
         if isinstance(mod, nn.MultiheadAttention):
+            if not mod.batch_first:
+                # torch's default layout is [S, B, E]; ffmodel.multihead_
+                # attention is batch-first, so tracing a default-configured
+                # module would silently swap batch and sequence dims.
+                raise NotImplementedError(
+                    "nn.MultiheadAttention requires batch_first=True "
+                    "(the [S, B, E] default layout is not supported)")
             return IRNode("multihead_attention", name, ins, {
                 "embed_dim": mod.embed_dim, "num_heads": mod.num_heads,
                 "dropout": mod.dropout})
@@ -212,7 +219,10 @@ class PyTorchModel:
             return IRNode("dropout", name, ins,
                           {"rate": node.kwargs.get("p", 0.5)})
         if t is torch.cat:
-            axis = node.kwargs.get("dim", scalars[0] if scalars else 0)
+            # args[0] is the tensor LIST (not an fx.Node), so it lands in
+            # `scalars`; a positional dim lives at args[1].
+            axis = node.kwargs.get(
+                "dim", node.args[1] if len(node.args) > 1 else 0)
             seq = node.args[0]
             return IRNode("concat", name, [n.name for n in seq],
                           {"axis": int(axis)})

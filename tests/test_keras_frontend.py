@@ -218,3 +218,18 @@ def test_preprocessing_utils():
     np.testing.assert_array_equal(padded, [[1, 2, 0]])
     onehot = to_categorical([0, 2], num_classes=3)
     np.testing.assert_array_equal(onehot, [[1, 0, 0], [0, 0, 1]])
+
+
+def test_same_padding_semantics():
+    """Keras SAME splits the total pad (total//2, total-total//2); the
+    symmetric builder represents exactly the even-total cases and must
+    reject odd totals instead of silently shifting windows (ADVICE r1)."""
+    import pytest
+    from flexflow_tpu.keras.layers import _conv_padding
+    # odd kernel, stride 1: classic symmetric halo
+    assert _conv_padding("same", 3, 3, 1, 1, 8, 8) == (1, 1)
+    # 2x2/2 pooling on even dims needs NO padding — must not be rejected
+    assert _conv_padding("same", 2, 2, 2, 2, 8, 8) == (0, 0)
+    # 3x3/2 conv on 224 needs (0,1) asymmetric padding -> reject
+    with pytest.raises(NotImplementedError, match="asymmetric"):
+        _conv_padding("same", 3, 3, 2, 2, 224, 224)

@@ -132,3 +132,42 @@ def test_onnx_elementwise_split_transpose():
     got = model.predict(x)
     want = ((x[:, :6] + x[:, 6:]) ** 2).T
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_asymmetric_pads_rejected():
+    """ONNX pads are [top, left, bottom, right]; asymmetric padding cannot be
+    represented by the symmetric-(ph, pw) builder and must raise, not
+    silently produce wrong shapes (ADVICE r1)."""
+    import pytest
+    nodes = [P.encode_node("Conv", ["x", "wc"], ["y"], name="c",
+                           kernel_shape=[3, 3], strides=[1, 1],
+                           pads=[1, 1, 0, 0], group=1)]
+    blob = P.encode_model(
+        nodes, inputs=[P.encode_value_info("x", [1, 1, 8, 8])],
+        outputs=[P.encode_value_info("y", [1, 2, 7, 7])],
+        initializers={"wc": np.zeros((2, 1, 3, 3), np.float32)})
+    model = ff.FFModel(ff.FFConfig(batch_size=1))
+    t = model.create_tensor([1, 1, 8, 8], ff.DataType.DT_FLOAT)
+    with pytest.raises(NotImplementedError, match="asymmetric"):
+        ONNXModel(blob).apply(model, {"x": t})
+
+
+def test_onnx_auto_pad_handling():
+    """auto_pad=VALID maps to zero padding; SAME_UPPER must raise."""
+    import pytest
+
+    def build(auto_pad):
+        nodes = [P.encode_node("MaxPool", ["x"], ["y"], name="p",
+                               kernel_shape=[2, 2], strides=[2, 2],
+                               auto_pad=auto_pad)]
+        blob = P.encode_model(
+            nodes, inputs=[P.encode_value_info("x", [1, 1, 8, 8])],
+            outputs=[P.encode_value_info("y", [1, 1, 4, 4])],
+            initializers={})
+        model = ff.FFModel(ff.FFConfig(batch_size=1))
+        t = model.create_tensor([1, 1, 8, 8], ff.DataType.DT_FLOAT)
+        return ONNXModel(blob).apply(model, {"x": t})
+
+    assert build(b"VALID")
+    with pytest.raises(NotImplementedError, match="SAME_UPPER"):
+        build(b"SAME_UPPER")

@@ -250,3 +250,30 @@ def test_spec_infer_multi_ssm_tree():
     spec = rm2.generate_spec_infer(llm, [ssm1, ssm2], spec_depth=3)
     for r in spec:
         assert incr[tuple(r.input_tokens)][:10] == r.output_tokens[:10]
+
+
+def test_spec_infer_multi_ssm_tree_near_limit():
+    """Two SSMs near the sequence limit: each chain fits `room` but the
+    MERGED tree (1 + 2*depth nodes) would stage KV past max_seq without the
+    tree cap (ADVICE r1). ssm1 is divergent (fills the early tree indices),
+    ssm2 shares the verifier's weights — so the chain the verifier accepts
+    occupies the tree's TAIL, exactly the nodes that overflow the cache —
+    and the output must still match incremental decoding."""
+    max_seq = 32
+    prompt = list(range(1, 26))                  # len 25, sp=24, cap=8 < 9
+    incr_model = make_model(seed=0, max_seq=max_seq)
+    rm = RequestManager()
+    rm.register_new_request(prompt, max_new_tokens=20)
+    (incr,) = rm.generate_incr_decoding(incr_model)
+    assert len(incr.output_tokens) == max_seq - len(prompt)
+
+    llm = make_model(mode=InferenceMode.TREE_VERIFY_MODE, seed=0,
+                     max_seq=max_seq)
+    ssm1 = make_model(mode=InferenceMode.BEAM_SEARCH_MODE, seed=3,
+                      max_seq=max_seq)
+    ssm2 = make_model(mode=InferenceMode.BEAM_SEARCH_MODE, seed=0,
+                      max_seq=max_seq)
+    rm2 = RequestManager()
+    rm2.register_new_request(prompt, max_new_tokens=20)
+    (spec,) = rm2.generate_spec_infer(llm, [ssm1, ssm2], spec_depth=4)
+    assert spec.output_tokens == incr.output_tokens

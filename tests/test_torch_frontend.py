@@ -246,3 +246,33 @@ def test_trained_torch_translation_trains_in_ff():
                   metrics=[ff.MetricsType.METRICS_ACCURACY])
     hist = model.fit(x, y, epochs=6)
     assert hist[-1]["loss"] < hist[0]["loss"]
+
+
+class CatPositionalDim(nn.Module):
+    def forward(self, x):
+        return torch.cat([x, torch.relu(x)], 1)   # positional dim
+
+
+def test_cat_positional_dim_alignment():
+    """torch.cat's tensor list is not an fx.Node, so a positional dim must be
+    read from args[1], not the scalar list (ADVICE r1)."""
+    x = np.random.RandomState(7).randn(4, 8).astype(np.float32)
+    _align(CatPositionalDim(), x, 4)
+
+
+class DefaultMHA(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.mha = nn.MultiheadAttention(16, 4)   # batch_first=False default
+
+    def forward(self, x):
+        out, _ = self.mha(x, x, x)
+        return out
+
+
+def test_mha_batch_first_false_rejected():
+    """The [S, B, E] default layout would silently swap batch and sequence
+    dims against the batch-first builder op — must raise (ADVICE r1)."""
+    pt = PyTorchModel(DefaultMHA())
+    with pytest.raises(NotImplementedError, match="batch_first"):
+        pt.to_ir()
