@@ -52,6 +52,13 @@ class FFConfig:
     export_strategy_file: str = ""
     include_costs_dot_graph: bool = False
     substitution_json_path: Optional[str] = None
+    # joint search: interleave algebraic GraphXfer rewrites with the
+    # parallelization DP (reference GraphSearchHelper::base_optimize)
+    enable_substitutions: bool = True
+    # profiled re-rank of the top searched strategies with measured per-op
+    # times (reference Op::measure_operator_cost). None = on for real
+    # accelerators, off on the CPU simulator.
+    search_profile: Optional[bool] = None
     # memory-aware search (reference graph.cc:2126 lambda binary search)
     mem_search_budget: int = -1
 

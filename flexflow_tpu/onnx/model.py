@@ -127,7 +127,8 @@ class ONNXModel:
                                          dtype=np.float32)
         elif op in ("MaxPool", "AveragePool"):
             kh, kw = _attr(node, "kernel_shape")
-            sh, sw = _attr(node, "strides", [kh, kw])
+            # ONNX defaults strides to 1 per spatial axis (NOT kernel_shape)
+            sh, sw = _attr(node, "strides", [1, 1])
             pads = conv_pads()
             pool = PoolType.POOL_MAX if op == "MaxPool" else PoolType.POOL_AVG
             t = ff.pool2d(data(0), int(kh), int(kw), int(sh), int(sw),

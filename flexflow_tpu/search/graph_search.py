@@ -37,14 +37,26 @@ class UnitySearch:
     def __init__(self, pcg: PCG, cost_model: CostModel,
                  axis_degrees: Dict[str, int], beam_width: int = 32,
                  budget: int = -1, alpha: float = 1.2,
-                 mem_lambda: float = 0.0):
+                 mem_lambda: float = 0.0, rules=None,
+                 enable_substitutions: bool = True):
         self.pcg = pcg
         self.cm = cost_model
         self.axes = dict(axis_degrees)
         self.beam_width = beam_width
-        self.budget = budget if budget > 0 else 1000
+        # budget = graph candidates the joint loop may evaluate; alpha = the
+        # tolerance for exploring slightly-worse rewrites (reference
+        # GraphSearchHelper::base_optimize, substitution.cc:2245)
+        self.budget = budget if budget > 0 else 64
         self.alpha = alpha
         self.mem_lambda = mem_lambda
+        self.enable_substitutions = enable_substitutions
+        self.rules = rules
+        # graph the winning strategy is keyed on (== pcg unless a
+        # substitution won)
+        self.best_graph: PCG = pcg
+        # (analytic cost, graph, strategy) of every graph the joint loop
+        # evaluated, best first — the pool the profiled re-rank draws from
+        self.top_candidates: List[Tuple[float, PCG, Strategy]] = []
 
     # ------------------------------------------------------------------
     def _node_candidates(self, node: PCGNode,
@@ -111,11 +123,14 @@ class UnitySearch:
         best = beams[0][1]
         return {i: s for i, s in best.items() if i not in boundary}
 
-    def optimize(self) -> Strategy:
-        splits = set(self.pcg.bottleneck_nodes())
+    def optimize_graph(self, pcg: PCG) -> Strategy:
+        """DP over one fixed graph: sequence-split at bottlenecks, beam
+        within each segment (the inner `Graph::optimal_cost` of the joint
+        search)."""
+        splits = set(pcg.bottleneck_nodes())
         segments: List[List[PCGNode]] = []
         cur: List[PCGNode] = []
-        for node in self.pcg.nodes:
+        for node in pcg.nodes:
             cur.append(node)
             if node.idx in splits:
                 segments.append(cur)
@@ -123,18 +138,126 @@ class UnitySearch:
         if cur:
             segments.append(cur)
 
-        chosen: Dict[int, OpStrategy] = {}
-        for seg in segments:
-            boundary = {i: chosen[i] for n in seg for i in n.in_edges
-                        if i in chosen}
-            chosen.update(self._optimize_segment(seg, boundary))
+        outer_pcg = self.pcg
+        self.pcg = pcg            # _candidate_delta reads producer nodes
+        try:
+            chosen: Dict[int, OpStrategy] = {}
+            for seg in segments:
+                boundary = {i: chosen[i] for n in seg for i in n.in_edges
+                            if i in chosen}
+                chosen.update(self._optimize_segment(seg, boundary))
+        finally:
+            self.pcg = outer_pcg
 
-        strategy = Strategy(ops={self.pcg.nodes[i].name: s
+        strategy = Strategy(ops={pcg.nodes[i].name: s
                                  for i, s in chosen.items()})
-        metrics = self.cm.simulate(self.pcg, strategy)
+        metrics = self.cm.simulate(pcg, strategy)
         strategy.cost = metrics.total
         strategy.peak_memory = metrics.memory
         return strategy
+
+    def optimize(self) -> Strategy:
+        """Joint substitution + parallelization search (reference
+        GraphSearchHelper::graph_optimize → base_optimize best-first over
+        GraphXfers, substitution.cc:1914/2245): pop the cheapest candidate
+        graph, try every rewrite, keep children within ``alpha`` of the
+        best, stop after ``budget`` DP evaluations. The winning graph is
+        left in ``self.best_graph`` (its nodes' ``covers`` map the strategy
+        back onto original layer names)."""
+        import heapq
+
+        best_s = self.optimize_graph(self.pcg)
+        self.best_graph = self.pcg
+        self.top_candidates = [(best_s.cost, self.pcg, best_s)]
+        if not self.enable_substitutions:
+            return best_s
+        from flexflow_tpu.search.substitution import GraphXfer, builtin_rules
+
+        rules = self.rules if self.rules is not None else builtin_rules()
+        xfers = [GraphXfer(r) for r in rules]
+        counter = 0
+        heap = [(best_s.cost, counter, self.pcg)]
+        seen = {_graph_signature(self.pcg)}
+        evals = 1
+        while heap and evals < self.budget:
+            cost, _, g = heapq.heappop(heap)
+            if cost > self.alpha * best_s.cost:
+                break                 # heap-ordered: the rest are worse
+            for xfer in xfers:
+                for m in xfer.find_matches(g):
+                    g2 = xfer.apply(g, m)
+                    if g2 is None:
+                        continue
+                    sig = _graph_signature(g2)
+                    if sig in seen:
+                        continue
+                    seen.add(sig)
+                    s2 = self.optimize_graph(g2)
+                    evals += 1
+                    self.top_candidates.append((s2.cost, g2, s2))
+                    if s2.cost < best_s.cost:
+                        best_s = s2
+                        self.best_graph = g2
+                    if s2.cost <= self.alpha * best_s.cost:
+                        counter += 1
+                        heapq.heappush(heap, (s2.cost, counter, g2))
+                    if evals >= self.budget:
+                        break
+                if evals >= self.budget:
+                    break
+        return best_s
+
+
+def _graph_signature(pcg: PCG):
+    """Structural hash for the joint search's dedup of rewritten graphs.
+    Includes attrs so parameter-only rewrites (e.g. two fusions differing
+    only in fused_activation) stay distinct candidates."""
+    return hash(tuple(
+        (n.op_type, tuple(n.covered_names), tuple(n.in_edges),
+         tuple(sorted((k, repr(v)) for k, v in n.attrs.items())))
+        for n in pcg.nodes))
+
+
+def profile_rerank(candidates: List[Tuple[float, PCG, Strategy]],
+                   cm: CostModel, topk: int = 4
+                   ) -> Tuple[PCG, Strategy]:
+    """Re-rank the analytically-best strategies by MEASURED per-op time
+    (``CostModel.measure_node`` jit-compiles and times each distinct
+    (op, shapes, sharding) leaf, cached by params-hash — the reference's
+    ``Op::measure_operator_cost`` + simulator.cc cache). Communication stays
+    analytic: collectives can't be measured in isolation on one host.
+
+    The cache bounds total time: a transformer's repeated layer blocks all
+    hit the same (op, shapes, sharding) keys, so k candidates cost only a
+    handful of compiles."""
+    scored = []
+    for cost, g, s in sorted(candidates, key=lambda c: c[0])[:topk]:
+        t = 0.0
+        for node in g.nodes:
+            st = s.ops.get(node.name)
+            if st is None:
+                continue
+            t += cm.measure_node(node, st)
+            m = cm.node_compute_time(node, st)
+            t += m.comm_time + m.sync_time
+        scored.append((t, g, s))
+    _, g, s = min(scored, key=lambda x: x[0])
+    return g, s
+
+
+def expand_strategy(graph: PCG, strategy: Strategy) -> Strategy:
+    """Map a strategy keyed on (possibly rewritten) PCG node names back onto
+    the original layer names via each node's ``covers`` provenance, so
+    compile() can look up every real layer."""
+    ops: Dict[str, OpStrategy] = {}
+    for n in graph.nodes:
+        st = strategy.ops.get(n.name)
+        if st is None:
+            continue
+        for cname in n.covered_names:
+            ops[cname] = st
+    return Strategy(ops=ops, cost=strategy.cost,
+                    peak_memory=strategy.peak_memory)
 
 
 def mcmc_optimize(pcg: PCG, cost_model: CostModel,
@@ -200,21 +323,65 @@ def optimize_model(model, chip: str = "cpu-sim",
     pcg = PCG.from_model(model)
     cm = CostModel(machine, axes, training=training)
     budget = config.search_budget
+    rules = None
+    if config.substitution_json_path:
+        from flexflow_tpu.search.substitution import (
+            builtin_rules, load_rules_json)
+
+        rules = builtin_rules() + load_rules_json(
+            config.substitution_json_path)
     lam = 0.0
     strategy = None
+    graph = pcg
+    cand_graphs = None
     for _attempt in range(6):
         cm_l = CostModel(machine, axes, training=training)
         search = UnitySearch(pcg, cm_l, axes, budget=budget,
-                             alpha=config.search_alpha, mem_lambda=lam)
-        strategy = search.optimize()
+                             alpha=config.search_alpha, mem_lambda=lam,
+                             rules=rules,
+                             enable_substitutions=config.enable_substitutions)
+        if cand_graphs is None:
+            # first attempt: full joint rewrite discovery
+            strategy = search.optimize()
+            graph = search.best_graph
+            cand_graphs = [g for _, g, _ in search.top_candidates]
+        else:
+            # λ retries: the rewrite pool is λ-independent — only re-score
+            # the already-discovered graphs under the new memory pressure
+            scored = []
+            for g in cand_graphs:
+                s = search.optimize_graph(g)
+                scored.append((s.cost + lam * s.peak_memory, g, s))
+            scored.sort(key=lambda c: c[0])
+            _, graph, strategy = scored[0]
+            search.best_graph = graph
+            search.top_candidates = [(s.cost, g, s) for _, g, s in scored]
         if strategy.peak_memory <= machine.memory_per_device() or lam > 1e6:
             break
         lam = max(lam * 8, 1e-9)     # grow λ until the strategy fits HBM
+    candidates = list(search.top_candidates)
     n_mcmc = mcmc_budget if mcmc_budget is not None else (
         budget if budget > 0 else 100)
-    strategy = mcmc_optimize(pcg, cm, axes, strategy, budget=n_mcmc,
+    strategy = mcmc_optimize(graph, cm, axes, strategy, budget=n_mcmc,
                              seed=config.seed,
                              memory_bound=machine.memory_per_device())
+    candidates.append((strategy.cost, graph, strategy))
+    # profiled re-rank (reference measure_operator_cost): default on when a
+    # real accelerator backs jax, off on the CPU simulator
+    profile = config.search_profile
+    if profile is None:
+        import jax
+
+        profile = jax.default_backend() != "cpu"
+    if profile:
+        # never let the re-rank resurrect a strategy the λ search rejected
+        # for oversubscribing HBM
+        fit = [c for c in candidates
+               if c[2].peak_memory <= machine.memory_per_device()]
+        graph, strategy = profile_rerank(fit or candidates, cm)
+    # a substitution may have won: expand fused nodes' strategies back onto
+    # the original layer names compile() looks up
+    strategy = expand_strategy(graph, strategy)
     if config.export_strategy_file:
         strategy.save(config.export_strategy_file)
     return strategy

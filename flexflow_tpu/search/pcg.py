@@ -50,6 +50,14 @@ class PCGNode:
     attrs: Dict = dataclasses.field(default_factory=dict)
     in_edges: List[int] = dataclasses.field(default_factory=list)   # node idxs
     out_edges: List[int] = dataclasses.field(default_factory=list)
+    # Original layer names this node stands for. A substitution that fuses
+    # k ops into one node unions their covers, so the searched strategy can
+    # be expanded back onto the model's real layers after the joint search.
+    covers: Optional[List[str]] = None
+
+    @property
+    def covered_names(self) -> List[str]:
+        return self.covers if self.covers is not None else [self.name]
 
     # ---- footprint -------------------------------------------------------
     @property

@@ -59,6 +59,10 @@ class OpX:
     op_type: Optional[OpType]            # None = wildcard
     inputs: List[Tuple[int, int]]
     params: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # attrs keys that must be ABSENT (or falsy) on a matched graph node —
+    # e.g. a fusion rule must not re-match an already-fused op, which would
+    # silently drop an activation pass from the searched graph
+    forbid: Tuple[str, ...] = ()
 
 
 @dataclasses.dataclass
@@ -69,6 +73,17 @@ class Rule:
     # (dst_op_idx, dst_ts, src_op_idx, src_ts) — which dst output replaces
     # which src output for consumers outside the match
     mapped_outputs: List[Tuple[int, int, int, int]]
+
+
+def _attr_present(v) -> bool:
+    """True if an attr value represents a real setting (AC_MODE_NONE and
+    other *_NONE enum members count as absent)."""
+    if v is None or v == 0 or v == "" or v is False:
+        return False
+    name = getattr(v, "name", None)
+    if isinstance(name, str) and name.endswith("NONE"):
+        return False
+    return True
 
 
 class GraphXfer:
@@ -95,6 +110,8 @@ class GraphXfer:
                     continue
                 if px.op_type is not None and node.op_type != px.op_type:
                     continue
+                if any(_attr_present(node.attrs.get(k)) for k in px.forbid):
+                    continue
                 # inputs must line up with already-bound pattern producers
                 ok = True
                 for slot, (src_op, _ts) in enumerate(px.inputs):
@@ -120,14 +137,22 @@ class GraphXfer:
         import copy
 
         matched = set(match.values())
-        src_nodes = [pcg.nodes[i] for i in match.values()]
-        # External inputs of the match, in pattern slot order
-        ext_inputs: Dict[Tuple[int, int], Tuple[int, int]] = {}
+        src_nodes = [pcg.nodes[match[pi]] for pi in range(len(self.rule.src))]
+        # External pattern tensors, identified by ts id (reference TensorX):
+        # producing graph node (None = a graph input) and tensor shape.
+        ext_producer: Dict[int, Optional[int]] = {}
+        ext_shape: Dict[int, Tuple[int, ...]] = {}
         for pi, px in enumerate(self.rule.src):
             g = pcg.nodes[match[pi]]
             for slot, (src_op, ts) in enumerate(px.inputs):
-                if src_op == -1 and slot < len(g.in_edges):
-                    ext_inputs[(pi, slot)] = (g.in_edges[slot], 0)
+                if src_op != -1:
+                    continue
+                prod = g.in_edges[slot] if slot < len(g.in_edges) else None
+                if ts in ext_producer and ext_producer[ts] != prod:
+                    return None          # inconsistent external binding
+                ext_producer[ts] = prod
+                if slot < len(g.input_shapes):
+                    ext_shape[ts] = g.input_shapes[slot]
 
         new_nodes: List[PCGNode] = []
         remap: Dict[int, int] = {}
@@ -138,11 +163,14 @@ class GraphXfer:
             remap[node.idx] = len(new_nodes)
             n2.idx = len(new_nodes)
             new_nodes.append(n2)
-        # Materialize dst pattern ops; shapes inherited from the mapped src
-        out_of = {(pi, 0): match[pi] for pi in range(len(self.rule.src))}
+        # Materialize dst pattern ops. Output shape/dtype come from the src
+        # op whose output this dst op replaces; for single-dst (fusion)
+        # rules the node also absorbs every matched op's weights and attrs,
+        # and `covers` unions their provenance so the final strategy can be
+        # expanded back onto the original layers.
+        single_dst = len(self.rule.dst) == 1
         dst_graph_idx: Dict[int, int] = {}
         for di, dx in enumerate(self.rule.dst):
-            # find a src op this dst op's output replaces → copy shapes
             proto = None
             for (dop, dts, sop, sts) in self.rule.mapped_outputs:
                 if dop == di:
@@ -155,27 +183,50 @@ class GraphXfer:
             n2.name = f"{proto.name}__xfer{di}"
             if dx.op_type is not None:
                 n2.op_type = dx.op_type
+            if single_dst:
+                weights: Dict[str, Tuple[int, ...]] = {}
+                attrs: Dict = {}
+                covers: List[str] = []
+                for s in src_nodes:
+                    for w, shape in s.weight_shapes.items():
+                        if w in weights:
+                            return None      # ambiguous fused weight name
+                        weights[w] = shape
+                    attrs.update(s.attrs)
+                    covers.extend(s.covered_names)
+                n2.weight_shapes = weights
+                n2.attrs = attrs
+                n2.covers = covers
+            else:
+                n2.covers = list(proto.covered_names)
+            n2.attrs = dict(n2.attrs)
+            n2.attrs.update(dx.params)
+            # input shapes follow the dst wiring, resolved below
+            n2.input_shapes = []
             n2.in_edges = []
             n2.out_edges = []
             dst_graph_idx[di] = n2.idx
             new_nodes.append(n2)
-        # Wire dst inputs
+        # Wire dst inputs (externals by ts id; graph inputs carry no edge)
         for di, dx in enumerate(self.rule.dst):
             n2 = new_nodes[dst_graph_idx[di]]
             for slot, (src_op, ts) in enumerate(dx.inputs):
                 if src_op == -1:
-                    # external slot — reuse the matched external producer
-                    ext = ext_inputs.get((0, slot)) or next(
-                        iter(ext_inputs.values()), None)
-                    if ext is None:
-                        continue
-                    src_graph = remap.get(ext[0])
+                    if ts in ext_shape:
+                        n2.input_shapes.append(ext_shape[ts])
+                    prod = ext_producer.get(ts)
+                    if prod is None:
+                        continue             # a graph input: no edge
+                    src_graph = remap.get(prod)
                     if src_graph is None:
-                        return None
+                        return None          # external produced inside match
                 else:
                     src_graph = dst_graph_idx.get(src_op)
                     if src_graph is None:
                         return None
+                    src_out = new_nodes[src_graph].output_shapes
+                    if ts < len(src_out):
+                        n2.input_shapes.append(src_out[ts])
                 n2.in_edges.append(src_graph)
                 new_nodes[src_graph].out_edges.append(n2.idx)
         # Re-route surviving nodes' inputs: unmatched producers keep their
@@ -202,7 +253,28 @@ class GraphXfer:
         for n2 in new_nodes:
             for e in n2.in_edges:
                 new_nodes[e].out_edges.append(n2.idx)
-        return PCG(new_nodes)
+        # Renumber into topological order: dst nodes were appended after the
+        # survivors, but PCG consumers (bottleneck_nodes, the beam's
+        # producers-first walk) require build order == topo order.
+        indeg = [len(n.in_edges) for n in new_nodes]
+        ready = [i for i, d in enumerate(indeg) if d == 0]
+        order: List[int] = []
+        while ready:
+            i = ready.pop(0)
+            order.append(i)
+            for j in new_nodes[i].out_edges:
+                indeg[j] -= 1
+                if indeg[j] == 0:
+                    ready.append(j)
+        if len(order) != len(new_nodes):
+            return None                    # rewrite introduced a cycle
+        pos = {old: new for new, old in enumerate(order)}
+        sorted_nodes = [new_nodes[i] for i in order]
+        for n2 in sorted_nodes:
+            n2.idx = pos[n2.idx]
+            n2.in_edges = [pos[e] for e in n2.in_edges]
+            n2.out_edges = [pos[e] for e in n2.out_edges]
+        return PCG(sorted_nodes)
 
 
 # ---------------------------------------------------------------------------
@@ -213,15 +285,27 @@ def builtin_rules() -> List[Rule]:
     ships 600+ TASO-generated rules; most are parallelization forms that the
     candidate enumeration already covers. These are the fusion-shaped ones.)"""
     rules = []
-    # linear → relu  ⇒  fused linear(relu)  (cost model sees one op)
+    # linear → activation  ⇒  fused linear(act): the cost model sees one op
+    # and stops paying the activation's memory-roofline pass (XLA performs
+    # the actual fusion; the rewrite lets the search reason about it).
+    no_act = ("fused_activation", "activation")
+    for act_op, act in ((OpType.RELU, "relu"), (OpType.GELU, "gelu"),
+                        (OpType.SIGMOID, "sigmoid"), (OpType.TANH, "tanh")):
+        rules.append(Rule(
+            name=f"fuse_linear_{act}",
+            src=[OpX(OpType.LINEAR, [(-1, 0)], forbid=no_act),
+                 OpX(act_op, [(0, 0)])],
+            dst=[OpX(OpType.LINEAR, [(-1, 0)],
+                     params={"fused_activation": act})],
+            mapped_outputs=[(0, 0, 1, 0)]))
+    # conv → relu  ⇒  fused conv(relu) (reference fuse_conv_relu family)
     rules.append(Rule(
-        name="fuse_linear_relu",
-        src=[OpX(OpType.LINEAR, [(-1, 0)]),
+        name="fuse_conv_relu",
+        src=[OpX(OpType.CONV2D, [(-1, 0)], forbid=no_act),
              OpX(OpType.RELU, [(0, 0)])],
-        dst=[OpX(OpType.LINEAR, [(-1, 0)], params={"fused_relu": 1})],
+        dst=[OpX(OpType.CONV2D, [(-1, 0)],
+                 params={"fused_activation": "relu"})],
         mapped_outputs=[(0, 0, 1, 0)]))
-    # ew_add of two outputs of the same-shaped linears sharing input ⇒
-    # concat-free: keep as-is (placeholder for reassociation family)
     return rules
 
 

@@ -278,3 +278,146 @@ def test_auto_parallel_weight_shardings_applied():
     strat_names = {s.name for s in model.strategy.ops.values()}
     if any("tp" in n for n in strat_names):
         assert sharded
+
+
+# ---------------------------------------------------------------------------
+# Joint substitution + parallelization search (reference base_optimize)
+# ---------------------------------------------------------------------------
+def fusible_mlp(batch=32, hidden=2048, auto=False, subst=True):
+    cfg = ff.FFConfig(batch_size=batch, tensor_parallelism_degree=2,
+                      data_parallelism_degree=2, auto_parallel=auto,
+                      enable_substitutions=subst)
+    model = ff.FFModel(cfg)
+    t = model.create_tensor([batch, 64], ff.DataType.DT_FLOAT)
+    x = model.dense(t, hidden)
+    x = model.relu(x)                # separate activation: fusible
+    x = model.dense(x, hidden)
+    x = model.gelu(x)
+    x = model.dense(x, 8)
+    model.softmax(x)
+    return model
+
+
+def test_joint_search_beats_substitution_free():
+    """The joint loop must find the fused form and return a strictly better
+    searched cost than parallelization-only (VERDICT r1 item 1; reference
+    GraphSearchHelper::base_optimize substitution.cc:2245)."""
+    model = fusible_mlp()
+    pcg = PCG.from_model(model)
+    axes = {"data": 2, "model": 4}
+    cm = CostModel(MachineModel.from_name("v5e", 8), axes, training=True)
+    off = UnitySearch(pcg, cm, axes, enable_substitutions=False).optimize()
+    joint = UnitySearch(pcg, cm, axes, enable_substitutions=True)
+    on = joint.optimize()
+    assert on.cost < off.cost
+    # the winning graph fused linear+relu and linear+gelu
+    fused = [n for n in joint.best_graph.nodes if len(n.covered_names) > 1]
+    assert fused, "no substitution applied"
+    covered = {c for n in joint.best_graph.nodes for c in n.covered_names}
+    assert covered == {n.name for n in pcg.nodes}
+    # rewritten graphs stay topologically ordered (bottleneck/beam invariant)
+    for n in joint.best_graph.nodes:
+        assert all(e < n.idx for e in n.in_edges)
+
+
+def test_joint_search_strategy_expands_to_all_layers_and_trains():
+    """optimize_model must expand a fused node's strategy back onto the
+    original layer names, and the compiled model must still learn."""
+    from flexflow_tpu.training.optimizer import SGDOptimizer
+
+    model = fusible_mlp(batch=32, hidden=128, auto=True)
+    model.compile(optimizer=SGDOptimizer(model, lr=0.05),
+                  loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[MetricsType.METRICS_ACCURACY])
+    assert model.strategy is not None
+    assert set(model.strategy.ops) == {l.name for l in model.layers}
+    rng = np.random.RandomState(0)
+    x = rng.randn(128, 64).astype(np.float32)
+    w = rng.randn(64, 8).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)[:, None]
+    first = model.train_one_batch([x[:32]], y[:32])
+    for _ in range(20):
+        for i in range(0, 128, 32):
+            loss = model.train_one_batch([x[i:i + 32]], y[i:i + 32])
+    assert loss < first
+
+
+def test_search_budget_and_alpha_consumed():
+    """budget bounds the number of DP evaluations; alpha=0 prunes every
+    rewrite immediately (best-first loop controls, previously dead knobs)."""
+    model = fusible_mlp()
+    pcg = PCG.from_model(model)
+    axes = {"data": 2, "model": 4}
+    cm = CostModel(MachineModel.from_name("v5e", 8), axes, training=True)
+    # budget=1: only the original graph is evaluated -> same as subst-off
+    s1 = UnitySearch(pcg, cm, axes, budget=1).optimize()
+    off = UnitySearch(pcg, cm, axes, enable_substitutions=False).optimize()
+    assert abs(s1.cost - off.cost) < 1e-18
+    # generous budget explores and wins
+    s64 = UnitySearch(pcg, cm, axes, budget=64).optimize()
+    assert s64.cost < off.cost
+
+
+def test_profile_rerank_selects_measured_winner():
+    """Profiled re-ranking (reference Op::measure_operator_cost) must pick a
+    candidate from the pool by measured time and hit the compile cache on
+    repeated (op, shapes, sharding) leaves (VERDICT r1 item 6)."""
+    from flexflow_tpu.search.graph_search import profile_rerank
+
+    model = fusible_mlp(batch=8, hidden=64)
+    pcg = PCG.from_model(model)
+    axes = {"data": 2, "model": 4}
+    cm = CostModel(MachineModel.from_name("v5e", 8), axes, training=False)
+    search = UnitySearch(pcg, cm, axes)
+    search.optimize()
+    assert len(search.top_candidates) >= 2
+    g, s = profile_rerank(search.top_candidates, cm, topk=3)
+    assert any(s is c[2] for c in search.top_candidates)
+    assert cm._profile_cache            # measured leaves were cached
+    # a second rerank is pure cache hits (bounded search time)
+    n = len(cm._profile_cache)
+    profile_rerank(search.top_candidates, cm, topk=3)
+    assert len(cm._profile_cache) == n
+
+
+def test_optimize_model_profile_flag():
+    """search_profile=True routes optimize_model through the measured
+    re-rank and still returns a full, fitting strategy."""
+    model = fusible_mlp(batch=8, hidden=64, auto=False)
+    model.config.auto_parallel = True
+    model.config.search_profile = True
+    strategy = optimize_model(model, chip="v5e", num_devices=8,
+                              training=False)
+    assert set(strategy.ops) == {l.name for l in model.layers}
+
+
+def test_fusion_rules_never_rematch_fused_nodes():
+    """dense -> relu -> sigmoid must NOT collapse into one node (two chained
+    activations are not one fusable epilogue); builder-fused dense(relu)
+    must not match either (code-review r2)."""
+    from flexflow_tpu.search.substitution import builtin_rules, GraphXfer
+
+    cfg = ff.FFConfig(batch_size=8)
+    model = ff.FFModel(cfg)
+    t = model.create_tensor([8, 32], ff.DataType.DT_FLOAT)
+    x = model.dense(t, 32)
+    x = model.relu(x)
+    model.sigmoid(x)
+    pcg = PCG.from_model(model)
+    axes = {"data": 2, "model": 4}
+    cm = CostModel(MachineModel.from_name("v5e", 8), axes)
+    search = UnitySearch(pcg, cm, axes)
+    search.optimize()
+    g = search.best_graph
+    # the relu fused into the linear; sigmoid must survive as its own node
+    assert len(g.nodes) == 2
+    ops = {n.op_type for n in g.nodes}
+    assert OpType.SIGMOID in ops
+    # builder-fused dense(relu) offers no match at all
+    model2 = ff.FFModel(ff.FFConfig(batch_size=8))
+    t2 = model2.create_tensor([8, 32], ff.DataType.DT_FLOAT)
+    x2 = model2.dense(t2, 32, ff.ActiMode.AC_MODE_RELU)
+    model2.relu(x2)
+    pcg2 = PCG.from_model(model2)
+    for rule in builtin_rules():
+        assert not GraphXfer(rule).find_matches(pcg2)
