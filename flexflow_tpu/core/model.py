@@ -100,6 +100,10 @@ class FFModel:
         # position_input tensor created by those model builders.
         self.position_input_tensor: Optional[Tensor] = None
         self.position_offset: int = 0
+        # pipeline-parallel serving plan (set by compile when
+        # pipeline_parallelism_degree > 1; see serve/pipeline_plan.py)
+        self._pp_plan = None
+        self._pp_segment_fn = None
 
     # ==================================================================
     # Tensor / layer creation
@@ -627,37 +631,48 @@ class FFModel:
     # ==================================================================
     # Graph execution
     # ==================================================================
-    def _run_graph(self, params, feeds: Dict[int, Any], ctx: OpContext,
-                   state: Optional[Dict[str, Any]] = None):
-        """Walk the layer list (creation order == topo order) computing every
-        tensor value. Returns (values_by_tensor_id, new_state)."""
-        values: Dict[int, Any] = dict(feeds)
-        ctx.state_in = state or {}
-        ctx.state_out = {}
+    def _apply_layer(self, layer, params, values: Dict[int, Any],
+                     ctx: OpContext):
+        """Execute one layer into ``values`` (offload fetch, lazy dequant,
+        searched-layout constraint)."""
         from flexflow_tpu.offload import fetch_layer_params
         from flexflow_tpu.quant import dequantize_layer_params
 
         offloaded = getattr(self, "_offloaded", None) or {}
+        impl = get_op_impl(layer.op_type)
+        ins = [values[t.tensor_id] for t in layer.inputs]
+        ctx.layer_name = layer.name
+        # host-offloaded weights stream back to HBM first (in their
+        # compressed form), then int8/int4 dequantizes lazily — all
+        # inside the jitted step so XLA overlaps transfer with compute
+        lp = params.get(layer.name, {})
+        if layer.name in offloaded:
+            lp = fetch_layer_params(lp, offloaded[layer.name])
+        lp = dequantize_layer_params(lp, ctx.compute_dtype)
+        outs = impl.forward(layer.attrs, lp, ins, ctx)
+        if self.strategy is not None and self.policy is not None:
+            strat_op = self.strategy.ops.get(layer.name)
+            if strat_op is not None and outs:
+                outs = [self.policy.constrain(outs[0],
+                                              strat_op.output_spec),
+                        *outs[1:]]
+        for t, v in zip(layer.outputs, outs):
+            values[t.tensor_id] = v
+
+    def _run_graph(self, params, feeds: Dict[int, Any], ctx: OpContext,
+                   state: Optional[Dict[str, Any]] = None):
+        """Walk the layer list (creation order == topo order) computing every
+        tensor value. Returns (values_by_tensor_id, new_state)."""
+        if (not ctx.training and self._pp_plan is not None
+                and "__pp_blocks__" in params):
+            from flexflow_tpu.serve.pipeline_plan import run_pp_graph
+
+            return run_pp_graph(self, params, feeds, ctx, state)
+        values: Dict[int, Any] = dict(feeds)
+        ctx.state_in = state or {}
+        ctx.state_out = {}
         for layer in self.layers:
-            impl = get_op_impl(layer.op_type)
-            ins = [values[t.tensor_id] for t in layer.inputs]
-            ctx.layer_name = layer.name
-            # host-offloaded weights stream back to HBM first (in their
-            # compressed form), then int8/int4 dequantizes lazily — all
-            # inside the jitted step so XLA overlaps transfer with compute
-            lp = params.get(layer.name, {})
-            if layer.name in offloaded:
-                lp = fetch_layer_params(lp, offloaded[layer.name])
-            lp = dequantize_layer_params(lp, ctx.compute_dtype)
-            outs = impl.forward(layer.attrs, lp, ins, ctx)
-            if self.strategy is not None and self.policy is not None:
-                strat_op = self.strategy.ops.get(layer.name)
-                if strat_op is not None and outs:
-                    outs = [self.policy.constrain(outs[0],
-                                                  strat_op.output_spec),
-                            *outs[1:]]
-            for t, v in zip(layer.outputs, outs):
-                values[t.tensor_id] = v
+            self._apply_layer(layer, params, values, ctx)
         new_state = dict(ctx.state_in)
         new_state.update(ctx.state_out)
         return values, new_state
@@ -682,6 +697,8 @@ class FFModel:
 
         self.mesh = make_mesh(self.config)
         self.policy = ShardingPolicy(self.mesh)
+        self._pp_plan = None
+        self._pp_segment_fn = None
 
         # --- Unity-style auto-parallelization (reference model.cc:3327
         # launches GRAPH_OPTIMIZE_TASK inside compile) ---
@@ -734,6 +751,21 @@ class FFModel:
                 self.op_state[layer.name] = impl.init_state(layer.attrs,
                                                             input_specs)
         self._consolidate_kv_caches()
+        # --- pipeline-parallel serving plan (reference
+        # inference_manager.cc:91-132 layer->stage placement); built after
+        # KV consolidation so blocks carry their cache_layer_idx ---
+        if (comp_mode == CompMode.COMP_MODE_INFERENCE
+                and "pipe" in self.mesh.shape and self.mesh.shape["pipe"] > 1):
+            from flexflow_tpu.serve.pipeline_plan import build_pipeline_plan
+
+            self._pp_plan = build_pipeline_plan(self,
+                                                self.mesh.shape["pipe"])
+            if self._pp_plan is None:
+                raise ValueError(
+                    "pipeline_parallelism_degree > 1 needs a homogeneous "
+                    "transformer-block serving graph (model-zoo style "
+                    "'<prefix>.{i}.' layer naming, num_layers divisible by "
+                    "the degree); this graph has no such decomposition")
         # Commit op-state (KV caches) to the mesh NOW: jit caches key on
         # argument shardings, so uncommitted zeros here would make the first
         # post-warmup call recompile every serving program once the donated
@@ -1007,10 +1039,28 @@ class FFModel:
                                       weight_name=(layer_name, weight_name))
         raise KeyError((layer_name, weight_name))
 
+    def finalize_pipeline(self):
+        """Stack block weights onto the pipe axis (no-op without a plan).
+        Call after loading weights; LLM.compile does this automatically."""
+        if self._pp_plan is not None:
+            from flexflow_tpu.serve.pipeline_plan import finalize_pipeline
+
+            finalize_pipeline(self)
+        return self
+
     def get_parameter_by_key(self, key: Tuple[str, str]) -> np.ndarray:
         layer_name, weight_name = key
         from flexflow_tpu.quant import dequantize_array, is_quantized
 
+        if layer_name not in self.params:
+            from flexflow_tpu.serve.pipeline_plan import (PP_PARAMS_KEY,
+                                                          stacked_param_lookup)
+
+            hit = stacked_param_lookup(self, layer_name, weight_name)
+            if hit is not None:
+                pos, i = hit
+                return np.asarray(
+                    self.params[PP_PARAMS_KEY][pos][weight_name][i])
         leaf = self.params[layer_name][weight_name]
         if is_quantized(leaf):
             return np.asarray(dequantize_array(leaf))
@@ -1050,6 +1100,19 @@ class FFModel:
         layer_name, weight_name = key
         from flexflow_tpu.quant import is_quantized, quantize_array
 
+        if layer_name not in self.params:
+            from flexflow_tpu.serve.pipeline_plan import (PP_PARAMS_KEY,
+                                                          stacked_param_lookup)
+
+            hit = stacked_param_lookup(self, layer_name, weight_name)
+            if hit is not None:
+                pos, i = hit
+                stack = self.params[PP_PARAMS_KEY][pos][weight_name]
+                arr = jnp.asarray(value, dtype=stack.dtype)
+                assert arr.shape == stack.shape[1:], (arr.shape, stack.shape)
+                self.params[PP_PARAMS_KEY][pos][weight_name] = \
+                    stack.at[i].set(arr)
+                return
         old = self.params[layer_name][weight_name]
         if is_quantized(old):   # writes to a quantized weight re-quantize
             arr = jnp.asarray(value, dtype=jnp.dtype(old.dtype))

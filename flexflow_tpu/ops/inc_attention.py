@@ -191,6 +191,9 @@ def _project_out(attrs, params, ctx, attn_out):
 # run vectorized over layers.
 # ----------------------------------------------------------------------
 def read_kv(ctx, attrs):
+    ov = getattr(ctx, "kv_override", None)
+    if ov is not None:   # pipeline-parallel block execution: the stage
+        return ov        # loop hands this layer its own KV slice directly
     idx = attrs.get("cache_layer_idx")
     if idx is None:
         st = ctx.state_in[ctx.layer_name]
@@ -200,6 +203,9 @@ def read_kv(ctx, attrs):
 
 
 def write_kv(ctx, attrs, k_cache, v_cache):
+    if getattr(ctx, "kv_override", None) is not None:
+        ctx.kv_written = (k_cache, v_cache)
+        return
     idx = attrs.get("cache_layer_idx")
     if idx is None:
         ctx.state_out[ctx.layer_name] = {"k_cache": k_cache,

@@ -182,7 +182,14 @@ class CostModel:
 
         try:
             impl = get_op_impl(node.op_type)
+            # same shard count as node_compute_time: output-spec degree
+            # captures col/dp splits; row-parallel shards the contraction
+            # dim, visible only via partial_axes — without it a measured
+            # row-parallel linear would be charged the FULL gemm time and
+            # lose to column-parallel regardless of the true winner
             shards = max(spec_degree(st.output_spec, self.axes), 1)
+            for a in st.partial_axes:
+                shards *= self.axes.get(a, 1)
             ins = [jnp.zeros(s, dtype=jnp.float32)
                    for s in node.input_shapes]
             params = {w: jnp.zeros(s, dtype=jnp.float32)

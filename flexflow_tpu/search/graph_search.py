@@ -344,7 +344,12 @@ def optimize_model(model, chip: str = "cpu-sim",
             # first attempt: full joint rewrite discovery
             strategy = search.optimize()
             graph = search.best_graph
-            cand_graphs = [g for _, g, _ in search.top_candidates]
+            # keep only the best few graphs for λ retries: each retry runs
+            # a full DP per graph, so re-scoring the whole discovered pool
+            # would multiply search cost ~budget× exactly when memory
+            # pressure already makes compile slow
+            cand_graphs = [g for _, g, _ in sorted(
+                search.top_candidates, key=lambda c: c[0])[:8]]
         else:
             # λ retries: the rewrite pool is λ-independent — only re-score
             # the already-discovered graphs under the new memory pressure

@@ -174,6 +174,10 @@ class LLM:
             # (reference -offload); quantize-then-offload streams 4-8x
             # fewer bytes per step
             self.ffmodel.offload_weights()
+        # stage-shard the transformer blocks over the "pipe" axis now that
+        # weights are loaded (reference inference_manager.cc:91-132
+        # places layer blocks per stage at model-compile time)
+        self.ffmodel.finalize_pipeline()
 
         self.rm = RequestManager()
         if self.tokenizer is not None:

@@ -115,6 +115,8 @@ class SpecChainEngine:
     def __init__(self, llm, ssm, depth: int = 4, max_rounds: int = 16):
         self.llm = llm
         self.ssm = ssm
+        llm.finalize_pipeline()
+        ssm.finalize_pipeline()
         self.depth = depth
         self.max_rounds = max_rounds
         self._compute_dtype = jnp.dtype(llm.config.compute_dtype)

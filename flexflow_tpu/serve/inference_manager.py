@@ -28,6 +28,11 @@ class InferenceManager:
 
     def __init__(self, model):
         self.model = model
+        model.finalize_pipeline()   # no-op unless a pipeline plan is pending
+        if model._pp_plan is not None and model.config.inference_debugging:
+            raise NotImplementedError(
+                "inference_debugging dumps need per-layer params; not "
+                "available with pipeline_parallelism_degree > 1")
         cfg = model.config
         self._compute_dtype = jnp.dtype(cfg.compute_dtype)
         self._step = jax.jit(self._step_impl, donate_argnums=(1,))
