@@ -1,41 +1,58 @@
 """Benchmark entry point — prints ONE JSON line.
 
 North-star metric (BASELINE.json): SpecInfer tree decoding tokens/s vs the
-incremental-decoding baseline on the same model/config (the reference's CI
-speed gate, tests/inference/python_inference_tests.sh:57
-compare_speed_spec_infer_incr_decoding). ``vs_baseline`` is the ratio
-spec_tokens_per_s / incr_tokens_per_s (target >= 2.0).
+incremental-decoding baseline on LLaMA-2-7B geometry (4096/11008/32L/32H),
+single v5e chip, int8 weights (the reference's 8-bit weight compression,
+config.h:161-163; bf16 7B = 13.5GB does not fit a 16GB chip beside its KV
+cache). ``vs_baseline`` is spec_tokens_per_s / incr_tokens_per_s — the
+reference CI speed gate (tests/inference/python_inference_tests.sh:57
+compare_speed_spec_infer_incr_decoding), target >= 2.0.
 
 Zero-egress environment: no HF checkpoint downloads, so the verifier is a
-randomly-initialized LLaMA-class decoder and the draft model is its 2-layer
-truncation, with the verifier's remaining layers' residual contributions
-damped (x0.01) so the truncated draft predicts the verifier's greedy output
-at a realistic acceptance rate (~3.4-4.4 committed tokens per depth-4
-verify round — the SpecInfer paper's measured range on real checkpoints).
-The measured quantity is serving-system throughput: scheduler + KV-cache +
-tree-verify machinery at production acceptance rates, not model quality.
+randomly-initialized LLaMA-2-7B-geometry decoder and the draft model is its
+2-layer truncation, with the verifier's remaining layers' residual
+contributions damped (x0.01) so the truncated draft predicts the verifier's
+greedy output at a realistic acceptance rate. The MEASURED acceptance
+distribution is reported next to the headline so the number cannot flatter
+(tokens_per_round ~= the SpecInfer paper's 3.4-4.4 range on real
+checkpoints). The measured quantity is serving-system throughput:
+scheduler + KV-cache + tree-verify machinery at production acceptance
+rates, not model quality.
+
+Also reported: ``train_mfu`` — model FLOPs utilization of one fused
+training step on a BERT-class encoder (the BASELINE.json Unity metric
+names train MFU; bench_train.py prints the full breakdown).
+
+``python bench.py --small`` runs the round-1 1.3B-class bf16 config
+instead (same harness, ~2x faster wall clock).
 """
 
 import json
+import sys
 import time
 
 import numpy as np
 
-# Verifier: LLaMA-1.3B-class. Draft: its first DRAFT_LAYERS layers.
-VOCAB = 32000
-HIDDEN = 2048
-INTER = 5504
-LAYERS = 24
-HEADS = 16
-KV_HEADS = 8
+SMALL = "--small" in sys.argv
+
+# Verifier geometry; draft = its first DRAFT_LAYERS layers.
+if SMALL:                 # LLaMA-1.3B-class, bf16 (round-1 config)
+    VOCAB, HIDDEN, INTER, LAYERS = 32000, 2048, 5504, 24
+    HEADS, KV_HEADS = 16, 8
+    QUANT = None
+    NEW_TOKENS = 160
+else:                     # LLaMA-2-7B geometry, int8 weights
+    VOCAB, HIDDEN, INTER, LAYERS = 32000, 4096, 11008, 32
+    HEADS, KV_HEADS = 32, 32
+    QUANT = "int8"
+    NEW_TOKENS = 96
 DRAFT_LAYERS = 2
 EPS = 0.01          # residual damping for layers >= DRAFT_LAYERS
 SPEC_DEPTH = 4
 NUM_REQUESTS = 8
 PROMPT_LEN = 32
-NEW_TOKENS = 160
 MAX_SEQ = 256
-DECODE_BLOCK = 128      # fused decode steps per device call
+DECODE_BLOCK = NEW_TOKENS + 32  # whole generation in ONE device call
 SPEC_ROUNDS = 64        # fused speculation rounds per device call
 # (the device loop exits early once every request's budget is drafted,
 # so the cap just has to exceed the worst-case round count)
@@ -56,6 +73,7 @@ def build_models():
                       max_tokens_per_batch=NUM_REQUESTS * PROMPT_LEN,
                       kv_cache_dtype="bfloat16",
                       compute_dtype="bfloat16", seed=7,
+                      quantization_type=QUANT,
                       decode_block_steps=DECODE_BLOCK,
                       spec_rounds_per_call=SPEC_ROUNDS)
 
@@ -63,15 +81,26 @@ def build_models():
         m = ff.FFModel(ffc)
         create_llama_model(m, cfg, mode=mode,
                            data_type=ff.DataType.DT_BFLOAT16)
+        # int8 weights quantize per layer AT INIT (compile), so peak HBM
+        # never holds the bf16 model — that is what fits 7B on one chip
         m.compile(comp_mode=ff.CompMode.COMP_MODE_INFERENCE)
         return m
 
     llm = build(vcfg, InferenceMode.TREE_VERIFY_MODE)
-    # Damp deep-layer residual writes so the truncated draft stays correlated.
+    # Damp deep-layer residual writes so the truncated draft stays
+    # correlated with the full model's greedy output.
+    from flexflow_tpu.quant import dequantize_array, is_quantized, \
+        quantize_array
+
+    def scaled(leaf, factor):
+        if is_quantized(leaf):
+            return quantize_array(dequantize_array(leaf) * factor, leaf.qtype)
+        return leaf * factor
+
     for i in range(DRAFT_LAYERS, LAYERS):
         for lname, w in ((f"layers.{i}.self_attn", "wo"),
                          (f"layers.{i}.mlp.down_proj", "kernel")):
-            llm.params[lname][w] = llm.params[lname][w] * EPS
+            llm.params[lname][w] = scaled(llm.params[lname][w], EPS)
     ssm = build(dcfg, InferenceMode.BEAM_SEARCH_MODE)
     for lname, lp in ssm.params.items():
         if lname in llm.params:
@@ -93,6 +122,40 @@ def run_requests(fn, prompts, new_tokens):
     return out_tokens / dt, results
 
 
+class AcceptanceMeter:
+    """Records the measured acceptance distribution of every speculation
+    round (VERDICT r1: the headline must report the rate it was measured
+    at, so a synthetic-acceptance setup can't flatter the ratio)."""
+
+    def __init__(self):
+        self.n_acc = []
+
+    def install(self):
+        from flexflow_tpu.serve.engine import SpecChainEngine
+
+        meter = self
+        orig = SpecChainEngine.run_block
+
+        def patched(eng, tok, pos, act, n, remaining=None):
+            a, n_acc = orig(eng, tok, pos, act, n, remaining)
+            meter.n_acc.append(np.asarray(n_acc))
+            return a, n_acc
+
+        SpecChainEngine.run_block = patched
+        self._restore = lambda: setattr(SpecChainEngine, "run_block", orig)
+        return self
+
+    def stats(self):
+        acc = np.concatenate([a.ravel() for a in self.n_acc])
+        acc = acc[acc >= 0]
+        return {
+            "rounds": int(acc.size),
+            "tokens_per_round": round(float(acc.mean() + 1), 2),
+            "acceptance_hist": np.bincount(acc, minlength=SPEC_DEPTH + 1)
+            .tolist(),
+        }
+
+
 def main():
     import jax
 
@@ -102,10 +165,9 @@ def main():
                for _ in range(NUM_REQUESTS)]
     warm = [p[:8] for p in prompts[:2]]
 
-    # Pre-compile every power-of-two block size the adaptive scheduler can
-    # pick, plus the prefill programs (via short warm runs). Cache garbage
-    # from these dummy calls is harmless: every request re-prefills from
-    # position 0.
+    # Pre-compile the block + prefill programs via short warm runs. Cache
+    # garbage from these dummy calls is harmless: every request re-prefills
+    # from position 0.
     from flexflow_tpu.serve.engine import SpecChainEngine
     from flexflow_tpu.serve.inference_manager import InferenceManager
 
@@ -123,37 +185,63 @@ def main():
     run_requests(lambda rm: rm.generate_spec_infer(llm, [ssm],
                                                    spec_depth=SPEC_DEPTH),
                  warm, 4)
-    jax.block_until_ready(llm.params["lm_head"]["kernel"])
+    jax.block_until_ready(llm.op_state["kv_cache"]["k"])
 
     # two timed passes each, best kept: the remote-tunnel dispatch latency
     # jitters ~10% run-to-run and the computation is deterministic
     incr_tps, incr_res = max(
         (run_requests(lambda rm: rm.generate_incr_decoding(llm), prompts,
                       NEW_TOKENS) for _ in range(2)), key=lambda r: r[0])
+    meter = AcceptanceMeter().install()
     spec_tps, spec_res = max(
         (run_requests(lambda rm: rm.generate_spec_infer(
             llm, [ssm], spec_depth=SPEC_DEPTH), prompts, NEW_TOKENS)
          for _ in range(2)), key=lambda r: r[0])
+    meter._restore()
 
     # correctness gate (reference check_partial_token_match asserts the
     # FIRST 30 tokens match, python_inference_tests.sh:29 — near-ties in
     # bf16 argmax between the width-(d+1) verify pass and width-1 decode
-    # eventually flip on a random-init model). Gate on the first 128
-    # tokens: 4x stricter than the reference CI.
-    MATCH_PREFIX = 128
+    # eventually flip on a random-init model). Report the reference's
+    # 30-token gate and a 4x stricter 128-token one.
     incr_by_in = {tuple(r.input_tokens): r.output_tokens for r in incr_res}
-    matched = sum(
-        incr_by_in[tuple(r.input_tokens)][:MATCH_PREFIX]
-        == r.output_tokens[:MATCH_PREFIX]
-        for r in spec_res)
+
+    def matches(prefix):
+        return sum(incr_by_in[tuple(r.input_tokens)][:prefix]
+                   == r.output_tokens[:prefix] for r in spec_res)
+
+    # train MFU on the same chip (full harness: bench_train.py)
+    del llm, ssm, eng, ifm
+    import gc
+
+    gc.collect()   # engine<->model reference cycles pin 7B of HBM otherwise
+    try:
+        from bench_train import measure_train_mfu
+
+        mfu = measure_train_mfu(steps=6)
+    except Exception as e:  # never lose the serving headline to train issues
+        mfu = {"train_mfu": f"error: {e}"}
 
     print(json.dumps({
         "metric": "specinfer_tokens_per_s",
+        "config": ("llama-1.3B-class bf16" if SMALL
+                   else "llama-2-7B-geometry int8"),
         "value": round(spec_tps, 2),
         "unit": "tokens/s",
         "vs_baseline": round(spec_tps / incr_tps, 3),
         "incr_tokens_per_s": round(incr_tps, 2),
-        "spec_matches_incr_first128": f"{matched}/{len(spec_res)}",
+        # Near-tie caveat: on this RANDOM-INIT (int8-quantized) model many
+        # logit gaps sit inside bf16 rounding, and XLA tiles a width-1
+        # decode gemm differently from a width-(d+1) verify gemm, so argmax
+        # occasionally flips with no real disagreement (teacher-forcing the
+        # mismatch position sides with the spec path). Real-checkpoint
+        # token parity is covered by tests/test_model_zoo.py HF alignment.
+        "spec_matches_incr_first30": f"{matches(30)}/{len(spec_res)}",
+        f"spec_matches_incr_first{min(128, NEW_TOKENS)}":
+            f"{matches(min(128, NEW_TOKENS))}/{len(spec_res)}",
+        # measured acceptance — the rate the headline was achieved at
+        **meter.stats(),
+        **mfu,
     }))
 
 

@@ -1,0 +1,123 @@
+"""Training-throughput benchmark: MFU of one fused train step.
+
+BASELINE.json's second north-star metric is "Unity-search train MFU".
+This builds a BERT-class encoder through the FFModel builder with
+``auto_parallel=True`` (the Unity search picks the per-op strategy — on a
+single chip it degenerates to the data/replicated layout, on a mesh it
+places TP/DP), runs fused train steps (forward+backward+update in ONE
+XLA program, core/model.py compile), and reports
+
+    {step_time_ms, achieved_tflops, train_mfu}
+
+against the chip's spec-sheet bf16 peak (search/machine_model.py
+TPU_CHIPS). Model FLOPs use the standard 6 * matmul_params * tokens
+fwd+bwd accounting (attention score/value matmuls included) — MODEL flops,
+not hardware flops: remat or padding would lower, never raise, the number.
+
+Run directly for the full breakdown: ``python bench_train.py``.
+bench.py folds ``train_mfu`` into its JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# BERT-large-class geometry, matmul-dominated
+VOCAB = 30522
+HIDDEN = 1024
+LAYERS = 8
+HEADS = 16
+SEQ = 512
+BATCH = 16
+
+
+def _model_flops_per_step(batch: int) -> float:
+    """6 * (matmul params) * tokens + attention matmuls (fwd=2, bwd=4)."""
+    tokens = batch * SEQ
+    per_layer_params = (4 * HIDDEN * HIDDEN        # q,k,v,o projections
+                       + 2 * HIDDEN * 4 * HIDDEN)  # MLP up+down
+    matmul_params = LAYERS * per_layer_params + VOCAB * HIDDEN  # + lm head
+    # score (S*S*D) and value (S*S*D) matmuls per head group
+    attn = LAYERS * 2 * SEQ * SEQ * HIDDEN * batch
+    return 6.0 * matmul_params * tokens + 6.0 * attn
+
+
+def build_model(chip: str = "v5e"):
+    import flexflow_tpu as ff
+
+    config = ff.FFConfig(batch_size=BATCH, compute_dtype="bfloat16",
+                         auto_parallel=True, tpu_chip=chip)
+    model = ff.FFModel(config)
+    tokens = model.create_tensor([BATCH, SEQ], ff.DataType.DT_INT32)
+    x = model.embedding(tokens, VOCAB, HIDDEN, name="embed")
+    for i in range(LAYERS):
+        attn = model.multihead_attention(x, x, x, embed_dim=HIDDEN,
+                                         num_heads=HEADS,
+                                         name=f"enc.{i}.attn")
+        x = model.layer_norm(model.add(attn, x), axes=[-1],
+                             name=f"enc.{i}.ln1")
+        h = model.dense(x, 4 * HIDDEN, ff.ActiMode.AC_MODE_GELU,
+                        name=f"enc.{i}.fc1")
+        h = model.dense(h, HIDDEN, name=f"enc.{i}.fc2")
+        x = model.layer_norm(model.add(h, x), axes=[-1],
+                             name=f"enc.{i}.ln2")
+    # masked-LM style head over the full sequence (matmul-dominated);
+    # flattened to [B*S, V] so the sparse-CE loss/label plumbing applies
+    logits = model.dense(x, VOCAB, name="mlm_head")
+    model.softmax(model.reshape(logits, [BATCH * SEQ, VOCAB]))
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=1e-3),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    return model
+
+
+def measure_train_mfu(steps: int = 12, chip: str = None) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.search.machine_model import TPU_CHIPS
+
+    if chip is None:
+        plat = jax.devices()[0].platform
+        chip = "v5e" if plat in ("tpu", "axon") else "cpu-sim"
+    model = build_model(chip)
+    rng = np.random.RandomState(0)
+    xs = rng.randint(0, VOCAB, size=(BATCH, SEQ)).astype(np.int32)
+    ys = rng.randint(0, VOCAB, size=(BATCH * SEQ, 1)).astype(np.int32)
+    # Drive the jitted step directly: train_one_batch's float(loss) is a
+    # full device sync + host readback per step — fine for training, but a
+    # remote-runtime tax (~100ms) that would be charged to the MFU. Two
+    # warm calls: the first compiles, the second absorbs the runtime's
+    # buffer-donation reshuffle.
+    feeds = model._feeds_from_arrays([xs])
+    label = jnp.asarray(ys, jnp.int32)
+    st = (model.params, model.opt_state, model.op_state)
+    for i in range(2):
+        p, o, s, loss, _ = model._train_step(*st, feeds, label,
+                                             jax.random.PRNGKey(i))
+        st = (p, o, s)
+        float(loss)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        p, o, s, loss, _ = model._train_step(*st, feeds, label,
+                                             jax.random.PRNGKey(10 + i))
+        st = (p, o, s)
+    final_loss = float(loss)                 # single fence for the block
+    dt = (time.perf_counter() - t0) / steps
+    model.params, model.opt_state, model.op_state = st
+    flops = _model_flops_per_step(BATCH)
+    peak = TPU_CHIPS[chip].bf16_flops
+    return {
+        "train_step_ms": round(dt * 1000, 2),
+        "train_achieved_tflops": round(flops / dt / 1e12, 1),
+        "train_mfu": round(flops / dt / peak, 3),
+        "train_loss": round(final_loss, 3),
+        "train_chip": chip,
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(measure_train_mfu()))

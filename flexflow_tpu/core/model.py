@@ -648,7 +648,8 @@ class FFModel:
         lp = params.get(layer.name, {})
         if layer.name in offloaded:
             lp = fetch_layer_params(lp, offloaded[layer.name])
-        lp = dequantize_layer_params(lp, ctx.compute_dtype)
+        if not impl.quant_aware:
+            lp = dequantize_layer_params(lp, ctx.compute_dtype)
         outs = impl.forward(layer.attrs, lp, ins, ctx)
         if self.strategy is not None and self.policy is not None:
             strat_op = self.strategy.ops.get(layer.name)
@@ -740,6 +741,17 @@ class FFModel:
                     wdims = strat_op.weight_specs[w.name]
                 sharding = self.policy.weight_sharding(w.shape, wdims)
                 lp[w.name] = jax.device_put(arr, sharding)
+            if (self.config.quantization_type
+                    and comp_mode == CompMode.COMP_MODE_INFERENCE):
+                # quantize each layer as it is initialized (the reference
+                # also compresses at load time, per tensor) — peak HBM
+                # holds ONE full-precision layer, so a 7B-class model can
+                # be built int8/int4 on a chip its bf16 form wouldn't fit
+                from flexflow_tpu.quant import quantize_params
+
+                lp = quantize_params({layer.name: lp},
+                                     self.config.quantization_type
+                                     )[layer.name]
             params[layer.name] = lp
         self.params = params
 

@@ -38,7 +38,14 @@ NEG_INF = -1e30  # finite "minus infinity": keeps online softmax NaN-free
 
 
 def _pick_block_s(S: int) -> int:
-    for bs in (512, 256, 128):
+    """Cache-stream block size: the smallest supported tile. Decode is
+    bandwidth-bound and reads ceil(length/BS)*BS keys per slot, so small
+    tiles waste the least on short/ragged lengths; the tile must also be
+    the SAME for every q-width — speculative decoding compares a width-1
+    decode against a width-(d+1) verify of the same positions, and a
+    different softmax block partition would flip near-tie argmaxes
+    (reference CI token-match gate, python_inference_tests.sh:29)."""
+    for bs in (128, 256, 512):
         if S % bs == 0:
             return bs
     return 0  # caller falls back to the jnp path
@@ -62,7 +69,7 @@ def _kernel(len_ref,                       # scalar prefetch: [R] int32
             o_ref,
             acc, m, l, kbuf, vbuf, bbuf, sem,
             *, BS: int, causal: bool, has_bias: bool, has_alibi: bool,
-            qk_scale: float, G: int, Q: int):
+            qk_scale: float, G: int, Q: int, layer_idx):
     r = pl.program_id(0)
     length = len_ref[r]
     nb = (length + jnp.asarray(BS - 1, length.dtype)) // BS
@@ -70,6 +77,13 @@ def _kernel(len_ref,                       # scalar prefetch: [R] int32
     acc[:] = jnp.zeros_like(acc)
     m[:] = jnp.full_like(m, NEG_INF)
     l[:] = jnp.zeros_like(l)
+
+    # stacked-cache mode: k/v are the whole [L, R, KH, S, D] buffers and
+    # this call streams only layer ``layer_idx`` — the caller never has to
+    # materialize a per-layer slice in HBM
+    if layer_idx is not None:
+        k_hbm = k_hbm.at[layer_idx]
+        v_hbm = v_hbm.at[layer_idx]
 
     def dmas(slot, i):
         yield pltpu.make_async_copy(
@@ -147,14 +161,17 @@ def _kernel(len_ref,                       # scalar prefetch: [R] int32
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "qk_scale", "interpret", "out_dtype"))
+    static_argnames=("causal", "qk_scale", "interpret", "out_dtype",
+                     "layer_idx"))
 def flash_attend(q, k_cache, v_cache, lengths, qpos, bias=None,
                  alibi=None, *, causal=True, qk_scale=None,
-                 out_dtype=None, interpret=False):
+                 out_dtype=None, layer_idx=None, interpret=False):
     """Batched KV-cache attention.
 
     q        [R, Q, H, D]   new-token queries (rotary already applied)
-    k/v      [R, KH, S, D]  full cache (new tokens already appended)
+    k/v      [R, KH, S, D]  full cache (new tokens already appended), or the
+                            whole stacked [L, R, KH, S, D] buffer with
+                            ``layer_idx`` selecting the layer to stream
     lengths  [R] int32      valid cache extent per request (0 => skip slot)
     qpos     [R, Q] int32   absolute position of each query token
     bias     [R, Q, S] f32  optional additive mask (tree mask; NEG_INF=hidden)
@@ -162,7 +179,7 @@ def flash_attend(q, k_cache, v_cache, lengths, qpos, bias=None,
     returns  [R, Q, H*D]
     """
     R, Q, H, D = q.shape
-    KH, S = k_cache.shape[1], k_cache.shape[2]
+    KH, S = k_cache.shape[-3], k_cache.shape[-2]
     G = H // KH
     GQ = G * Q
     BS = _pick_block_s(S)
@@ -192,7 +209,8 @@ def flash_attend(q, k_cache, v_cache, lengths, qpos, bias=None,
 
     kern = functools.partial(
         _kernel, BS=BS, causal=causal, has_bias=has_bias,
-        has_alibi=has_alibi, qk_scale=float(qk_scale), G=G, Q=Q)
+        has_alibi=has_alibi, qk_scale=float(qk_scale), G=G, Q=Q,
+        layer_idx=layer_idx)
 
     cache_dt = k_cache.dtype
     grid_spec = pltpu.PrefetchScalarGridSpec(

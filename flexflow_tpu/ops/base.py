@@ -59,6 +59,10 @@ class OpContext:
 
 class OpImpl:
     op_type: OpType = None
+    # quant-aware ops consume QuantizedWeight leaves directly (factored
+    # scale, int8 read inside the gemm fusion — quant.qmatmul/qtake);
+    # others get eagerly-dequantized params from the graph walker
+    quant_aware: bool = False
 
     @staticmethod
     def infer_output_specs(attrs: Dict[str, Any],

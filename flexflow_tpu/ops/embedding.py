@@ -19,6 +19,7 @@ from flexflow_tpu.ops.base import OpImpl, register_op
 @register_op
 class Embedding(OpImpl):
     op_type = OpType.EMBEDDING
+    quant_aware = True
 
     @staticmethod
     def infer_output_specs(attrs, input_specs):
@@ -40,9 +41,11 @@ class Embedding(OpImpl):
 
     @staticmethod
     def forward(attrs, params, inputs, ctx):
+        from flexflow_tpu.quant import qtake
+
         ids = inputs[0].astype(jnp.int32)
         table = params["weight"]
-        out = jnp.take(table, ids, axis=0)
+        out = qtake(table, ids)   # gather rows, dequantize only the rows
         aggr = attrs.get("aggr", AggrMode.AGGR_MODE_NONE)
         if aggr == AggrMode.AGGR_MODE_SUM:
             out = jnp.sum(out, axis=-2)

@@ -62,14 +62,32 @@ def apply_rotary(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray
 # ----------------------------------------------------------------------
 def append_kv(cache: jnp.ndarray, new: jnp.ndarray, start_pos: jnp.ndarray,
               num_tokens: jnp.ndarray, active: jnp.ndarray) -> jnp.ndarray:
-    """Scatter new [R, Q, KH, D] into cache [R, KH, S, D] at per-slot offsets.
+    """Write new [R, Q, KH, D] into cache [R, KH, S, D] at per-slot offsets.
 
-    Padding tokens and inactive slots are routed out of bounds and dropped.
-    The head-major cache layout keeps each head's [S, D] block contiguous,
-    which is what the Pallas decode kernel streams per KH-batched matmul.
+    Padding tokens and inactive slots are dropped. The head-major cache
+    layout keeps each head's [S, D] block contiguous, which is what the
+    Pallas decode kernel streams per KH-batched matmul.
+
+    Decode (Q == 1) scatters one D-row per (request, head): XLA keeps the
+    cache's canonical {3,2,1,0} layout for that index pattern and updates
+    the donated buffer in place. The windowed [KH, D] scatter it would
+    otherwise emit gets a {3,1,2,0}-permuted output layout plus a
+    full-cache copy per layer per step to re-feed the (default-layout)
+    Pallas kernel — ~8MB x 2 x n_layers of pure HBM traffic per decode
+    step. Prefill / tree steps (Q > 1) keep the windowed scatter: the copy
+    cost is amortized over the whole chunk.
     """
     R, Q = new.shape[0], new.shape[1]
     S = cache.shape[2]
+    KH = cache.shape[1]
+    if Q == 1:
+        valid = (num_tokens > 0) & active & (start_pos < S)
+        rows = jnp.broadcast_to(jnp.arange(R)[:, None], (R, KH))
+        heads = jnp.broadcast_to(jnp.arange(KH)[None, :], (R, KH))
+        cols = jnp.where(valid[:, None],
+                         jnp.broadcast_to(start_pos[:, None], (R, KH)), S)
+        upd = jnp.swapaxes(new.astype(cache.dtype), 1, 2)[:, :, 0]  # [R,KH,D]
+        return cache.at[rows, heads, cols].set(upd, mode="drop")
     rows = jnp.arange(R)[:, None]                                   # [R, 1]
     cols = start_pos[:, None] + jnp.arange(Q)[None, :]              # [R, Q]
     valid = (jnp.arange(Q)[None, :] < num_tokens[:, None]) & active[:, None]
@@ -77,14 +95,43 @@ def append_kv(cache: jnp.ndarray, new: jnp.ndarray, start_pos: jnp.ndarray,
     return cache.at[rows, :, cols].set(new.astype(cache.dtype), mode="drop")
 
 
+def append_kv_stacked(stack: jnp.ndarray, layer_idx: int, new: jnp.ndarray,
+                      start_pos: jnp.ndarray, num_tokens: jnp.ndarray,
+                      active: jnp.ndarray) -> jnp.ndarray:
+    """Write new [R, Q, KH, D] into the stacked cache [L, R, KH, S, D] at
+    layer ``layer_idx``, in place.
+
+    Scattering one D-row per (layer, request, head, token) keeps the
+    stack's canonical layout and updates the donated buffer with no
+    slice-out/write-back round trip — the per-layer alternative
+    (``stack[i]`` -> append -> ``stack.at[i].set``) costs an 8.4MB read +
+    8.4MB write per cache per layer per step at bench geometry.
+    """
+    R, Q = new.shape[0], new.shape[1]
+    KH, S = stack.shape[2], stack.shape[3]
+    sh = (R, KH, Q)
+    lidx = jnp.full(sh, layer_idx, jnp.int32)
+    rows = jnp.broadcast_to(jnp.arange(R)[:, None, None], sh)
+    heads = jnp.broadcast_to(jnp.arange(KH)[None, :, None], sh)
+    cols = (jnp.broadcast_to(start_pos[:, None, None], sh)
+            + jnp.arange(Q)[None, None, :])
+    valid = ((jnp.arange(Q)[None, None, :] < num_tokens[:, None, None])
+             & active[:, None, None])
+    cols = jnp.where(valid, cols, S)  # out of bounds -> dropped
+    upd = jnp.swapaxes(new.astype(stack.dtype), 1, 2)       # [R, KH, Q, D]
+    return stack.at[lidx, rows, heads, cols].set(upd, mode="drop")
+
+
 def _qkv(attrs, params, x, compute_dtype):
     """Project x [R, Q, E] -> q [R,Q,H,D], k/v [R,Q,KH,D]."""
+    from flexflow_tpu.quant import qmatmul
+
     H = attrs["num_q_heads"]
     KH = attrs["num_kv_heads"]
     D = attrs["head_dim"]
-    q = x @ params["wq"]
-    k = x @ params["wk"]
-    v = x @ params["wv"]
+    q = qmatmul(x, params["wq"])
+    k = qmatmul(x, params["wk"])
+    v = qmatmul(x, params["wv"])
     if "bq" in params:
         q, k, v = q + params["bq"], k + params["bk"], v + params["bv"]
     R, Q = x.shape[0], x.shape[1]
@@ -105,8 +152,12 @@ def alibi_slopes(num_heads: int) -> jnp.ndarray:
 
 
 def _attend(attrs, q, k_cache, v_cache, lengths, qpos, out_dtype, ctx,
-            bias=None, causal=True):
+            bias=None, causal=True, layer_idx=None):
     """q [R,Q,H,D] x cache [R,KH,S,D] -> [R, Q, H*D].
+
+    With ``layer_idx`` the caches are the full stacked [L, R, KH, S, D]
+    buffers and only that layer is read — the Pallas kernel DMAs straight
+    out of the stack, so no per-layer slice is ever materialized in HBM.
 
     Dispatches to the Pallas flash kernel on TPU (kernels/attention.py) or
     the jnp oracle elsewhere. ``lengths`` [R] is the valid cache extent
@@ -123,7 +174,7 @@ def _attend(attrs, q, k_cache, v_cache, lengths, qpos, out_dtype, ctx,
         scale = scale * attrs.get("scaling_factor", 1.0)
     alibi = (alibi_slopes(attrs["num_q_heads"])
              if attrs.get("position_bias", False) else None)
-    S = k_cache.shape[2]
+    S = k_cache.shape[-2]
     cfg = ctx.config if ctx is not None else None
     from flexflow_tpu.kernels.attention import supports_shapes
     if ffk.use_pallas(cfg) and supports_shapes(S, q.shape[-1]) \
@@ -131,7 +182,9 @@ def _attend(attrs, q, k_cache, v_cache, lengths, qpos, out_dtype, ctx,
         return flash_attend(
             q, k_cache, v_cache, lengths, qpos, bias=bias, alibi=alibi,
             causal=causal, qk_scale=scale, out_dtype=out_dtype,
-            interpret=ffk.pallas_interpret_forced())
+            layer_idx=layer_idx, interpret=ffk.pallas_interpret_forced())
+    if layer_idx is not None:
+        k_cache, v_cache = k_cache[layer_idx], v_cache[layer_idx]
     return reference_attend(
         q, k_cache, v_cache, lengths, qpos, bias=bias, alibi=alibi,
         causal=causal, qk_scale=scale, out_dtype=out_dtype)
@@ -174,7 +227,9 @@ def _init_kv_state(attrs, input_specs):
 
 
 def _project_out(attrs, params, ctx, attn_out):
-    out = attn_out @ params["wo"]
+    from flexflow_tpu.quant import qmatmul
+
+    out = qmatmul(attn_out, params["wo"])
     if "bo" in params:
         out = out + params["bo"]
     return out
@@ -216,6 +271,33 @@ def write_kv(ctx, attrs, k_cache, v_cache):
                                  "v": st["v"].at[idx].set(v_cache)}
 
 
+def append_and_ref(ctx, attrs, k, v, start_pos, num_tokens, active):
+    """Append this step's KV and return (k_ref, v_ref, layer_idx) to attend
+    over: layer_idx is None when the refs are this layer's own [R,KH,S,D]
+    caches, or the layer's index when they are the full [L,...] stack
+    (stacked caches append in place — see append_kv_stacked).
+
+    Only decode (Q == 1) takes the row-granular stacked path: its scatter
+    is ~R*KH index rows and beats the slice-out/write-back round trip by
+    ~0.45ms/step at bench geometry. Wider steps (prefill chunks, tree
+    verify) invert — R*KH*Q row-scatters cost more scalar-unit time than
+    the windowed scatter + one cache copy they'd save — and keep the
+    per-layer slice path."""
+    ov = getattr(ctx, "kv_override", None)
+    idx = attrs.get("cache_layer_idx")
+    if ov is not None or idx is None or k.shape[1] != 1:
+        k0, v0 = read_kv(ctx, attrs)
+        kc = append_kv(k0, k, start_pos, num_tokens, active)
+        vc = append_kv(v0, v, start_pos, num_tokens, active)
+        write_kv(ctx, attrs, kc, vc)
+        return kc, vc, None
+    st = ctx.state_out.get("kv_cache") or ctx.state_in["kv_cache"]
+    ks = append_kv_stacked(st["k"], idx, k, start_pos, num_tokens, active)
+    vs = append_kv_stacked(st["v"], idx, v, start_pos, num_tokens, active)
+    ctx.state_out["kv_cache"] = {"k": ks, "v": vs}
+    return ks, vs, idx
+
+
 @register_op_as(OpType.INC_MULTIHEAD_SELF_ATTENTION,
                 OpType.SPEC_INC_MULTIHEAD_SELF_ATTENTION)
 class IncMultiHeadSelfAttention(OpImpl):
@@ -227,6 +309,7 @@ class IncMultiHeadSelfAttention(OpImpl):
     """
 
     op_type = OpType.INC_MULTIHEAD_SELF_ATTENTION
+    quant_aware = True
 
     @staticmethod
     def infer_output_specs(attrs, input_specs):
@@ -242,25 +325,21 @@ class IncMultiHeadSelfAttention(OpImpl):
         x = inputs[0]
         meta = ctx.batch_config
         assert meta is not None, "serving ops need ctx.batch_config"
-        k_cache0, v_cache0 = read_kv(ctx, attrs)
         q, k, v = _qkv(attrs, params, x, ctx.compute_dtype)
         if attrs.get("apply_rotary_embedding", False):
             cos, sin = rotary_cos_sin(meta.positions, attrs["head_dim"],
                                       attrs.get("rope_theta", 10000.0), q.dtype)
             q = apply_rotary(q, cos, sin)
             k = apply_rotary(k, cos, sin)
-        k_cache = append_kv(k_cache0, k, meta.start_pos,
-                            meta.num_tokens, meta.active)
-        v_cache = append_kv(v_cache0, v, meta.start_pos,
-                            meta.num_tokens, meta.active)
-        write_kv(ctx, attrs, k_cache, v_cache)
+        k_ref, v_ref, layer_idx = append_and_ref(
+            ctx, attrs, k, v, meta.start_pos, meta.num_tokens, meta.active)
         # Causal over absolute cache positions: query token i (at position
         # start+i) sees cache[s] for s <= start+i (enforced in the kernel).
         Q = x.shape[1]
         q_abs = meta.start_pos[:, None] + jnp.arange(Q)[None, :]   # [R,Q]
         lengths = jnp.where(meta.active, meta.start_pos + meta.num_tokens, 0)
-        out = _attend(attrs, q, k_cache, v_cache, lengths, q_abs, x.dtype,
-                      ctx, causal=True)
+        out = _attend(attrs, q, k_ref, v_ref, lengths, q_abs, x.dtype,
+                      ctx, causal=True, layer_idx=layer_idx)
         return [_project_out(attrs, params, ctx, out)]
 
 
@@ -276,6 +355,7 @@ class TreeIncMultiHeadSelfAttention(OpImpl):
     """
 
     op_type = OpType.TREE_INC_MULTIHEAD_SELF_ATTENTION
+    quant_aware = True
 
     @staticmethod
     def infer_output_specs(attrs, input_specs):
@@ -294,7 +374,6 @@ class TreeIncMultiHeadSelfAttention(OpImpl):
             # Prompt prefill reaches the verify model as a plain causal
             # batch (a chain is a degenerate tree) — same as incremental.
             return IncMultiHeadSelfAttention.forward(attrs, params, inputs, ctx)
-        k_cache0, v_cache0 = read_kv(ctx, attrs)
         q, k, v = _qkv(attrs, params, x, ctx.compute_dtype)
         if attrs.get("apply_rotary_embedding", False):
             cos, sin = rotary_cos_sin(meta.positions, attrs["head_dim"],
@@ -303,14 +382,11 @@ class TreeIncMultiHeadSelfAttention(OpImpl):
             k = apply_rotary(k, cos, sin)
         # Stage tree KV at cache[start + node_idx] (node order is the
         # flattened tree, so this is the same scatter as incremental append).
-        k_cache = append_kv(k_cache0, k, meta.start_pos,
-                            meta.num_nodes, meta.active)
-        v_cache = append_kv(v_cache0, v, meta.start_pos,
-                            meta.num_nodes, meta.active)
-        write_kv(ctx, attrs, k_cache, v_cache)
+        k_ref, v_ref, layer_idx = append_and_ref(
+            ctx, attrs, k, v, meta.start_pos, meta.num_nodes, meta.active)
         # Tree mask as additive bias: committed prefix (s < start) is open by
         # default; within the tree region only ancestor-or-self is open.
-        S = k_cache.shape[2]
+        S = k_ref.shape[-2]
         T = x.shape[1]
         key_pos = jnp.arange(S)[None, None, :]
         committed = key_pos < meta.start_pos[:, None, None]        # [R,1,S]
@@ -325,8 +401,9 @@ class TreeIncMultiHeadSelfAttention(OpImpl):
         from flexflow_tpu.kernels.attention import NEG_INF
         bias = jnp.where(key_mask, 0.0, NEG_INF).astype(jnp.float32)
         lengths = jnp.where(meta.active, meta.start_pos + meta.num_nodes, 0)
-        out = _attend(attrs, q, k_cache, v_cache, lengths, meta.positions,
-                      x.dtype, ctx, bias=bias, causal=False)
+        out = _attend(attrs, q, k_ref, v_ref, lengths, meta.positions,
+                      x.dtype, ctx, bias=bias, causal=False,
+                      layer_idx=layer_idx)
         return [_project_out(attrs, params, ctx, out)]
 
 

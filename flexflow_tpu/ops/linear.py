@@ -41,6 +41,7 @@ def apply_activation(x, mode: ActiMode):
 @register_op
 class Linear(OpImpl):
     op_type = OpType.LINEAR
+    quant_aware = True
 
     @staticmethod
     def infer_output_specs(attrs, input_specs):
@@ -71,16 +72,19 @@ class Linear(OpImpl):
 
     @staticmethod
     def forward(attrs, params, inputs, ctx):
+        from flexflow_tpu.quant import is_quantized, qmatmul
+
         x = inputs[0]
         kernel = params["kernel"]
         compute_dtype = ctx.compute_dtype or x.dtype
-        y = jax.lax.dot_general(
-            x.astype(compute_dtype), kernel.astype(compute_dtype),
-            dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32
-            if compute_dtype != jnp.float64 else jnp.float64,
-        )
-        y = y.astype(compute_dtype)
+        if is_quantized(kernel) or compute_dtype != jnp.float64:
+            y = qmatmul(x, kernel, compute_dtype)
+        else:
+            y = jax.lax.dot_general(
+                x.astype(compute_dtype), kernel.astype(compute_dtype),
+                dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float64,
+            ).astype(compute_dtype)
         if attrs.get("use_bias", True):
             y = y + params["bias"].astype(compute_dtype)
         return [apply_activation(y, attrs.get("activation",

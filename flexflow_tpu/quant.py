@@ -115,6 +115,47 @@ def quantize_params(params: Dict[str, Dict[str, Any]], qtype: str,
     return out
 
 
+def qmatmul(x, w, compute_dtype=None):
+    """``x @ w`` for a possibly-quantized 2-D weight, with the per-column
+    scale factored OUT of the gemm: y = (x @ q) * scale.
+
+    Exact for the symmetric per-column scheme (diag-scale commutes with the
+    contraction), and crucial for bandwidth: the gemm fusion then reads the
+    int8 payload straight from HBM with an on-the-fly convert, instead of
+    XLA materializing a dequantized bf16 copy of the weight (int8 read +
+    bf16 write + bf16 read = 3x the traffic — measured ~25% of a 7B int8
+    decode step before this path existed)."""
+    cd = compute_dtype or x.dtype
+    if not is_quantized(w):
+        y = jax.lax.dot_general(
+            x.astype(cd), jnp.asarray(w).astype(cd),
+            dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return y.astype(cd)
+    payload = w.q
+    if w.qtype == "int4":
+        payload = _unpack_int4(payload, w.rows)
+    y = jax.lax.dot_general(
+        x.astype(cd), payload.astype(cd),
+        dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return (y * w.scale).astype(cd)
+
+
+def qtake(table, ids):
+    """Embedding-row gather for a possibly-quantized table: gather the int8
+    rows first, dequantize only the gathered rows (the eager path would
+    materialize the whole dequantized table per step)."""
+    if not is_quantized(table):
+        return jnp.take(table, ids, axis=0)
+    payload = table.q
+    if table.qtype == "int4":
+        payload = _unpack_int4(payload, table.rows)
+    rows = jnp.take(payload, ids, axis=0)
+    out_dtype = jnp.dtype(table.dtype)
+    return (rows.astype(jnp.float32) * table.scale).astype(out_dtype)
+
+
 def dequantize_layer_params(ws: Optional[Dict[str, Any]], dtype=None):
     """Lazily dequantize one layer's weights (called inside the jitted
     step; XLA fuses the scale-multiply into the consumer matmul)."""

@@ -66,6 +66,36 @@ def _forward_tokens(model, params, state, tokens, positions, start_pos,
     return forward_with_meta(model, params, state, meta, rng, compute_dtype)
 
 
+def make_draft_chain(model, compute_dtype, depth: int):
+    """Build a fused greedy draft-chain program for one SSM.
+
+    Signature: (params, op_state, tok [R], pos [R], active [R], rng) ->
+    (chain [R, depth], new_op_state). One device call replaces ``depth``
+    width-1 ``InferenceManager.step`` calls in the multi-SSM tree path
+    (each step is a host round trip; under remote runtimes that dominated
+    the whole draft phase). KV for drafted tokens is written tentatively —
+    the host rewinds its cache-depth bookkeeping and overwrites next round,
+    exactly as the unfused path did.
+    """
+
+    def chain(params, op_state, tok, pos, active, rng):
+        num = active.astype(jnp.int32)
+
+        def body(carry, i):
+            state, t, p = carry
+            out, state = _forward_tokens(
+                model, params, state, t[:, None], p[:, None], p, num,
+                active, jax.random.fold_in(rng, i), compute_dtype)
+            nxt = out[:, 0].astype(jnp.int32)
+            return (state, nxt, p + 1), nxt
+
+        (op_state, _, _), toks = jax.lax.scan(
+            body, (op_state, tok, pos), jnp.arange(depth))
+        return jnp.transpose(toks), op_state                # [R, depth]
+
+    return jax.jit(chain, donate_argnums=(1,))
+
+
 def make_decode_block(model, compute_dtype, max_steps: int):
     """Build the jitted dynamic-length decode program for ``model``.
 

@@ -34,6 +34,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
 from flexflow_tpu.serve.batch_config import (
     BatchMeta,
@@ -579,25 +580,66 @@ class RequestManager:
     def _draft_chains(self, ifm, ssm_idx, live, R, depth):
         """Greedy depth-``depth`` chain per live request on one SSM.
 
-        Every step is a width-1 decode: the prefill loop has already caught
-        each SSM's cache up to exactly one pending token (after a divergent
-        acceptance the missing committed tokens go through the prefill
-        program like any other prompt chunk).
+        The whole chain runs as ONE fused device program
+        (engine.make_draft_chain: a scan of width-1 decodes) — the unfused
+        version paid a host round trip per token per SSM, which under
+        remote runtimes made multi-SSM speculation slower than incremental
+        decoding. The prefill loop has already caught each SSM's cache up
+        to exactly one pending token (after a divergent acceptance the
+        missing committed tokens go through the prefill program like any
+        other prompt chunk).
         """
-        rows = []
+        from flexflow_tpu.serve.engine import make_draft_chain
+
+        model = ifm.model
+        if model.config.inference_debugging:
+            # debug mode serializes into per-step step() calls so every
+            # draft token's op tensors are dumped (the fused scan body
+            # cannot host-dump); same numerics, slower.
+            return self._draft_chains_debug(ifm, ssm_idx, live, R, depth)
+        fn = getattr(model, "_draft_chain_fn", None)
+        if fn is None or model._draft_chain_depth != depth:
+            fn = make_draft_chain(model, ifm._compute_dtype, depth)
+            model._draft_chain_fn = fn
+            model._draft_chain_depth = depth
+        tok = np.zeros((R,), np.int32)
+        pos = np.zeros((R,), np.int32)
+        act = np.zeros((R,), bool)
         for req in live:
             d = req.ssm_cache_depth.get(ssm_idx, 0)
             assert d == len(req.tokens) - 1, (d, len(req.tokens))
+            tok[req.slot] = req.tokens[-1]
+            pos[req.slot] = d
+            act[req.slot] = True
+        ifm._rng, step_rng = jax.random.split(ifm._rng)
+        toks, model.op_state = fn(model.params, model.op_state,
+                                  jnp.asarray(tok), jnp.asarray(pos),
+                                  jnp.asarray(act), step_rng)
+        toks = np.asarray(toks)
+        chains = {}
+        for req in live:
+            chains[req.slot] = [int(t) for t in toks[req.slot]]
+            # the chain commits the pending token's KV (+1); drafted tokens
+            # beyond it are tentative — cache entries past the accepted
+            # point are overwritten next round, so bookkeeping stays at d+1
+            req.ssm_cache_depth[ssm_idx] += 1
+        return chains
+
+    def _draft_chains_debug(self, ifm, ssm_idx, live, R, depth):
+        """Unfused per-token draft loop, kept for inference_debugging dumps
+        (one InferenceManager.step per drafted token)."""
+        rows = []
+        for req in live:
+            d = req.ssm_cache_depth.get(ssm_idx, 0)
             rows.append((req.slot, req.tokens[-1:], d))
         meta = self._meta_from_rows(R, 1, rows)
         out = ifm.step(meta)
         chains = {}
         last = {}
-        for req, (slot, catch, d) in zip(live, rows):
+        for req, (slot, _catch, d) in zip(live, rows):
             tok = int(out[slot, 0])
             chains[slot] = [tok]
             last[slot] = tok
-            # cache now holds everything incl. the last committed token
             req.ssm_cache_depth[ssm_idx] = d + 1
         for _ in range(depth - 1):
             rows = [(req.slot, [last[req.slot]],
@@ -609,9 +651,6 @@ class RequestManager:
                 tok = int(out[req.slot, 0])
                 chains[req.slot].append(tok)
                 last[req.slot] = tok
-        # drafted tokens beyond the committed prefix are tentative: cache
-        # entries past the accepted point are overwritten next round, so we
-        # rewind the bookkeeping to the committed depth after drafting
         for req in live:
             req.ssm_cache_depth[ssm_idx] -= (depth - 1)
         return chains
