@@ -34,6 +34,10 @@ import time
 import numpy as np
 
 SMALL = "--small" in sys.argv
+# --multi-ssm: draft with TWO truncations (2- and 3-layer) through the
+# fused MultiSpecEngine tree path instead of the single-SSM chain engine —
+# the reference's multi-SSM SpecInfer configuration
+MULTI = "--multi-ssm" in sys.argv
 
 # Verifier geometry; draft = its first DRAFT_LAYERS layers.
 if SMALL:                 # LLaMA-1.3B-class, bf16 (round-1 config)
@@ -67,7 +71,6 @@ def build_models():
                        intermediate_size=INTER, num_hidden_layers=LAYERS,
                        num_attention_heads=HEADS, num_key_value_heads=KV_HEADS,
                        max_position_embeddings=MAX_SEQ)
-    dcfg = LLAMAConfig(**{**vcfg.__dict__, "num_hidden_layers": DRAFT_LAYERS})
     ffc = ff.FFConfig(max_requests_per_batch=NUM_REQUESTS,
                       max_sequence_length=MAX_SEQ,
                       max_tokens_per_batch=NUM_REQUESTS * PROMPT_LEN,
@@ -101,12 +104,18 @@ def build_models():
         for lname, w in ((f"layers.{i}.self_attn", "wo"),
                          (f"layers.{i}.mlp.down_proj", "kernel")):
             llm.params[lname][w] = scaled(llm.params[lname][w], EPS)
-    ssm = build(dcfg, InferenceMode.BEAM_SEARCH_MODE)
-    for lname, lp in ssm.params.items():
-        if lname in llm.params:
-            for w in lp:
-                ssm.params[lname][w] = llm.params[lname][w]
-    return llm, ssm
+    draft_layer_counts = ([DRAFT_LAYERS, DRAFT_LAYERS + 1] if MULTI
+                          else [DRAFT_LAYERS])
+    ssms = []
+    for n in draft_layer_counts:
+        dc = LLAMAConfig(**{**vcfg.__dict__, "num_hidden_layers": n})
+        ssm = build(dc, InferenceMode.BEAM_SEARCH_MODE)
+        for lname, lp in ssm.params.items():
+            if lname in llm.params:
+                for w in lp:
+                    ssm.params[lname][w] = llm.params[lname][w]
+        ssms.append(ssm)
+    return (llm, ssms) if MULTI else (llm, ssms[0])
 
 
 def run_requests(fn, prompts, new_tokens):
@@ -131,18 +140,19 @@ class AcceptanceMeter:
         self.n_acc = []
 
     def install(self):
-        from flexflow_tpu.serve.engine import SpecChainEngine
+        from flexflow_tpu.serve.engine import MultiSpecEngine, SpecChainEngine
 
         meter = self
-        orig = SpecChainEngine.run_block
+        cls = MultiSpecEngine if MULTI else SpecChainEngine
+        orig = cls.run_block
 
         def patched(eng, tok, pos, act, n, remaining=None):
             a, n_acc = orig(eng, tok, pos, act, n, remaining)
             meter.n_acc.append(np.asarray(n_acc))
             return a, n_acc
 
-        SpecChainEngine.run_block = patched
-        self._restore = lambda: setattr(SpecChainEngine, "run_block", orig)
+        cls.run_block = patched
+        self._restore = lambda: setattr(cls, "run_block", orig)
         return self
 
     def stats(self):
@@ -160,6 +170,7 @@ def main():
     import jax
 
     llm, ssm = build_models()
+    ssms = list(ssm) if MULTI else [ssm]
     rng = np.random.RandomState(0)
     prompts = [[int(t) for t in rng.randint(1, VOCAB, size=PROMPT_LEN)]
                for _ in range(NUM_REQUESTS)]
@@ -168,21 +179,26 @@ def main():
     # Pre-compile the block + prefill programs via short warm runs. Cache
     # garbage from these dummy calls is harmless: every request re-prefills
     # from position 0.
-    from flexflow_tpu.serve.engine import SpecChainEngine
+    from flexflow_tpu.serve.engine import (MultiSpecEngine, SpecChainEngine)
     from flexflow_tpu.serve.inference_manager import InferenceManager
 
     llm._inference_manager = ifm = InferenceManager(llm)
-    ssm._inference_manager = InferenceManager(ssm)
-    llm._chain_engine = eng = SpecChainEngine(llm, ssm, SPEC_DEPTH,
-                                              max_rounds=SPEC_ROUNDS)
+    for s in ssms:
+        s._inference_manager = InferenceManager(s)
     tok0 = np.zeros((NUM_REQUESTS,), np.int32)
     pos0 = np.zeros((NUM_REQUESTS,), np.int32)
     act0 = np.ones((NUM_REQUESTS,), bool)
+    if MULTI:
+        llm._multi_engine = eng = MultiSpecEngine(llm, ssms, SPEC_DEPTH,
+                                                  max_rounds=SPEC_ROUNDS)
+    else:
+        llm._chain_engine = eng = SpecChainEngine(llm, ssms[0], SPEC_DEPTH,
+                                                  max_rounds=SPEC_ROUNDS)
     # one compile each: the block programs take a dynamic trip count
     ifm.decode_block(tok0, pos0, act0, 1)
     eng.run_block(tok0, pos0, act0, 1)
     run_requests(lambda rm: rm.generate_incr_decoding(llm), warm, 4)
-    run_requests(lambda rm: rm.generate_spec_infer(llm, [ssm],
+    run_requests(lambda rm: rm.generate_spec_infer(llm, ssms,
                                                    spec_depth=SPEC_DEPTH),
                  warm, 4)
     jax.block_until_ready(llm.op_state["kv_cache"]["k"])
@@ -195,7 +211,7 @@ def main():
     meter = AcceptanceMeter().install()
     spec_tps, spec_res = max(
         (run_requests(lambda rm: rm.generate_spec_infer(
-            llm, [ssm], spec_depth=SPEC_DEPTH), prompts, NEW_TOKENS)
+            llm, ssms, spec_depth=SPEC_DEPTH), prompts, NEW_TOKENS)
          for _ in range(2)), key=lambda r: r[0])
     meter._restore()
 
@@ -211,7 +227,7 @@ def main():
                    == r.output_tokens[:prefix] for r in spec_res)
 
     # train MFU on the same chip (full harness: bench_train.py)
-    del llm, ssm, eng, ifm
+    del llm, ssm, ssms, eng, ifm
     import gc
 
     gc.collect()   # engine<->model reference cycles pin 7B of HBM otherwise

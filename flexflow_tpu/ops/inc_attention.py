@@ -178,7 +178,11 @@ def _attend(attrs, q, k_cache, v_cache, lengths, qpos, out_dtype, ctx,
     cfg = ctx.config if ctx is not None else None
     from flexflow_tpu.kernels.attention import supports_shapes
     if ffk.use_pallas(cfg) and supports_shapes(S, q.shape[-1]) \
-            and q.shape[1] <= 256:
+            and q.shape[1] <= 256 \
+            and (bias is None or q.shape[1] % 8 == 0):
+        # biased (tree) attention DMAs [Q, BS] bias blocks; Mosaic needs
+        # the sublane (Q) dim 8-aligned — unaligned tree widths take the
+        # jnp path (MultiSpecEngine pads its tree so this never triggers)
         return flash_attend(
             q, k_cache, v_cache, lengths, qpos, bias=bias, alibi=alibi,
             causal=causal, qk_scale=scale, out_dtype=out_dtype,

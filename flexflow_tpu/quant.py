@@ -143,15 +143,19 @@ def qmatmul(x, w, compute_dtype=None):
 
 
 def qtake(table, ids):
-    """Embedding-row gather for a possibly-quantized table: gather the int8
-    rows first, dequantize only the gathered rows (the eager path would
-    materialize the whole dequantized table per step)."""
+    """Embedding-row gather for a possibly-quantized table: gather the
+    packed rows first, dequantize only the gathered rows (the eager path
+    would materialize the whole dequantized table per step)."""
     if not is_quantized(table):
         return jnp.take(table, ids, axis=0)
-    payload = table.q
     if table.qtype == "int4":
-        payload = _unpack_int4(payload, table.rows)
-    rows = jnp.take(payload, ids, axis=0)
+        # rows pack in pairs: entry r lives in packed row r//2, nibble r%2
+        packed = jnp.take(table.q, ids // 2, axis=0)
+        lo = (packed << 4).astype(jnp.int8) >> 4
+        hi = packed >> 4
+        rows = jnp.where((ids % 2 == 0)[..., None], lo, hi)
+    else:
+        rows = jnp.take(table.q, ids, axis=0)
     out_dtype = jnp.dtype(table.dtype)
     return (rows.astype(jnp.float32) * table.scale).astype(out_dtype)
 

@@ -131,6 +131,283 @@ def make_decode_block(model, compute_dtype, max_steps: int):
     return jax.jit(block, donate_argnums=(1,))
 
 
+class MultiSpecEngine:
+    """Fully-fused multi-SSM tree speculation: one device call per block.
+
+    Per round, ALL inside one jitted while_loop (the unfused path paid a
+    host round trip per drafted token per SSM plus one per verify/commit —
+    reference request_manager.cc walks the same phases as separate Legion
+    task batches):
+
+    * each SSM drafts a depth-``d`` greedy chain; the first draft step is
+      width-(d+1) and doubles as the CATCH-UP over last round's accepted
+      block, so a draft cache whose chain lost the previous round gets the
+      accepted tokens' KV rewritten before drafting (the unfused path did
+      this via prefill calls);
+    * the chains verify as one token tree with B branches off the root —
+      chains are NOT merged (the host path dedups shared prefixes; here
+      duplicate nodes just cost verify slots), so the tree topology, its
+      ancestor mask, and every node's cache slot are COMPILE-TIME
+      constants;
+    * greedy acceptance picks the branch with the longest matching prefix
+      (branches are linear, so tree acceptance reduces to a per-branch
+      cumprod + argmax);
+    * accepted nodes' KV compacts from branch ``j``'s slots to the
+      committed region in-program (the reference's commit_tokens_kernel,
+      tree_inc_multihead_self_attention.cu:35), vectorized over the
+      stacked layer dim.
+    """
+
+    def __init__(self, llm, ssms, depth: int = 4, max_rounds: int = 16):
+        self.llm = llm
+        self.ssms = list(ssms)
+        llm.finalize_pipeline()
+        for s in self.ssms:
+            s.finalize_pipeline()
+        self.depth = depth
+        self.max_rounds = max_rounds
+        self._compute_dtype = jnp.dtype(llm.config.compute_dtype)
+        nssm = len(self.ssms)
+        self._block = jax.jit(
+            self._block_impl,
+            donate_argnums=(1,) + tuple(3 + 2 * i for i in range(nssm)))
+        self._rng_const = jax.random.PRNGKey(llm.config.seed)
+
+    # -- static tree topology: root + B unmerged chains ----------------
+    @property
+    def tree_width(self) -> int:
+        """Verify width: real nodes padded to a sublane multiple (Mosaic
+        DMAs slice the [Q, BS] bias block, so Q must be 8-aligned; padding
+        nodes are masked off via num_nodes and their outputs unread)."""
+        T = 1 + len(self.ssms) * self.depth
+        return -(-T // 8) * 8
+
+    def _tree_constants(self, R):
+        d, B = self.depth, len(self.ssms)
+        T = 1 + B * d
+        Tp = self.tree_width
+        parent = np.full((Tp,), -1, np.int64)
+        depth_of = np.zeros((Tp,), np.int64)
+        for j in range(B):
+            for i in range(d):
+                n = 1 + j * d + i
+                parent[n] = 0 if i == 0 else n - 1
+                depth_of[n] = i + 1
+        anc = np.zeros((Tp, Tp), bool)
+        for n in range(T):
+            m = n
+            while m != -1:
+                anc[n, m] = True
+                m = parent[m]
+        return (jnp.asarray(np.broadcast_to(parent, (R, Tp))),
+                jnp.asarray(depth_of),
+                jnp.asarray(np.broadcast_to(anc, (R, Tp, Tp))))
+
+    def _draft(self, j, params, state, tks, nblk, base, active, rng):
+        """Catch-up + chain for SSM j. tks [R, d+1] = last round's accepted
+        block (count nblk, first token at position base). Returns
+        (state, chain [R, d])."""
+        d = self.depth
+        R = tks.shape[0]
+        ssm = self.ssms[j]
+        num = jnp.where(active, nblk, 0)
+        pos = base[:, None] + jnp.arange(d + 1)[None, :]
+        out, state = _forward_tokens(
+            ssm, params, state, tks, pos, base, num, active,
+            jax.random.fold_in(rng, 0), self._compute_dtype)
+        # next token = argmax after the block's LAST real token
+        t = jnp.take_along_axis(
+            out, jnp.maximum(nblk - 1, 0)[:, None], axis=1)[:, 0]
+        t = t.astype(jnp.int32)
+        r_pos = base + nblk - 1                     # root position
+        chain0 = t
+
+        def body(carry, i):
+            state, t, p = carry
+            out, state = _forward_tokens(
+                ssm, params, state, t[:, None], p[:, None], p,
+                active.astype(jnp.int32), active,
+                jax.random.fold_in(rng, 1 + i), self._compute_dtype)
+            nxt = out[:, 0].astype(jnp.int32)
+            return (state, nxt, p + 1), nxt
+
+        (state, _, _), rest = jax.lax.scan(
+            body, (state, t, r_pos + 1), jnp.arange(d - 1))
+        chain = jnp.concatenate([chain0[:, None], jnp.transpose(rest)],
+                                axis=1)             # [R, d]
+        return state, chain
+
+    def _commit(self, llm_state, best_j, n_acc, r_pos, active):
+        """cache[r, :, r_pos+1+i] <- cache[r, :, r_pos+1+best_j*d+i] for
+        i < n_acc, all layers (branch 0 is already contiguous)."""
+        d = self.depth
+        st = llm_state["kv_cache"]
+
+        def move(cache):                            # [L, R, KH, S, D]
+            L, R, KH, S, D = cache.shape
+            i = jnp.arange(d)[None, :]              # committed index
+            src = r_pos[:, None] + 1 + best_j[:, None] * d + i
+            src = jnp.clip(src, 0, S - 1)
+            moved = jnp.take_along_axis(
+                cache, src[None, :, None, :, None], axis=3)  # [L,R,KH,d,D]
+            valid = (i < n_acc[:, None]) & active[:, None]
+            dst = jnp.where(valid, r_pos[:, None] + 1 + i, S)
+            lidx = jnp.broadcast_to(
+                jnp.arange(L)[:, None, None, None], (L, R, KH, d))
+            rows = jnp.broadcast_to(
+                jnp.arange(R)[None, :, None, None], (L, R, KH, d))
+            heads = jnp.broadcast_to(
+                jnp.arange(KH)[None, None, :, None], (L, R, KH, d))
+            dstb = jnp.broadcast_to(dst[None, :, None, :], (L, R, KH, d))
+            return cache.at[lidx, rows, heads, dstb].set(moved, mode="drop")
+
+        return {**llm_state,
+                "kv_cache": {"k": move(st["k"]), "v": move(st["v"])}}
+
+    def _round(self, llm_params, llm_state, ssm_ps, ssm_states, tks, nblk,
+               base, active, rng):
+        d, B = self.depth, len(self.ssms)
+        R = tks.shape[0]
+        T = 1 + B * d
+        # (sequence-length safety: _block_impl's live_mask gates entry)
+        r_pos = base + nblk - 1
+
+        chains = []
+        for j in range(B):
+            ssm_states[j], chain = self._draft(
+                j, ssm_ps[j], ssm_states[j], tks, nblk, base, active,
+                jax.random.fold_in(rng, 100 + j))
+            chains.append(chain)
+
+        # --- verify: root + B chains as a constant-topology tree ---
+        from flexflow_tpu.serve.batch_config import TreeBatchMeta
+
+        root = jnp.take_along_axis(
+            tks, jnp.maximum(nblk - 1, 0)[:, None], axis=1)[:, 0]
+        tokens = jnp.concatenate([root[:, None]] + chains, axis=1)  # [R,T]
+        Tp = self.tree_width
+        tokens = jnp.pad(tokens, ((0, 0), (0, Tp - T)))
+        parent, depth_of, anc = self._tree_constants(R)
+        positions = r_pos[:, None] + depth_of[None, :]
+        meta = TreeBatchMeta(
+            tokens=tokens, positions=positions, parent=parent,
+            ancestor=anc, start_pos=r_pos,
+            num_nodes=jnp.where(active, T, 0).astype(jnp.int32),
+            active=active)
+        out, llm_state = forward_with_meta(
+            self.llm, llm_params, llm_state, meta,
+            jax.random.fold_in(rng, 7), self._compute_dtype)
+        o = out.astype(jnp.int32)                   # [R, T]
+
+        # --- per-branch greedy acceptance, best branch wins ---
+        n_js = []
+        for j in range(B):
+            pred = jnp.concatenate(
+                [o[:, :1], o[:, 1 + j * d: j * d + d]], axis=1)  # [R, d]
+            match = (chains[j] == pred).astype(jnp.int32)
+            # longest matching prefix = index of the first mismatch
+            # (argmin of [match, 0] — cumprod lowers to a slow O(d^2)
+            # reduce-window on some backends)
+            n_js.append(jnp.argmin(
+                jnp.pad(match, ((0, 0), (0, 1))), axis=1).astype(jnp.int32))
+        n_mat = jnp.stack(n_js, axis=1)             # [R, B]
+        best_j = jnp.argmax(n_mat, axis=1).astype(jnp.int32)
+        n_acc = jnp.max(n_mat, axis=1)
+        bonus_idx = jnp.where(n_acc == 0, 0, 1 + best_j * d + n_acc - 1)
+        bonus = jnp.take_along_axis(o, bonus_idx[:, None], axis=1)[:, 0]
+        best_chain = jnp.take_along_axis(
+            jnp.stack(chains, axis=1), best_j[:, None, None], axis=1)[:, 0]
+
+        llm_state = self._commit(llm_state, best_j, n_acc, r_pos, active)
+
+        # next round's accepted block: [accepted chain prefix, bonus]
+        blk = jnp.zeros((R, d + 1), jnp.int32)
+        idx = jnp.arange(d + 1)[None, :]
+        blk = jnp.where(idx < n_acc[:, None],
+                        jnp.pad(best_chain, ((0, 0), (0, 1))), blk)
+        blk = jnp.where(idx == n_acc[:, None], bonus[:, None], blk)
+        new_nblk = n_acc + 1
+        new_base = r_pos + 1
+        return (llm_state, ssm_states, blk, new_nblk, new_base, best_chain,
+                n_acc, bonus)
+
+    def _block_impl(self, llm_params, llm_state, *rest):
+        B = len(self.ssms)
+        ssm_ps = [rest[2 * i] for i in range(B)]
+        ssm_states = [rest[2 * i + 1] for i in range(B)]
+        (tok, pos, active, n_rounds, remaining) = rest[2 * B:]
+        R = tok.shape[0]
+        d = self.depth
+        max_seq = self.llm.config.max_sequence_length
+        rng0 = jax.random.fold_in(self._rng_const, pos.sum())
+        packed0 = jnp.full((R, self.max_rounds, d + 2), 0, jnp.int32)
+        packed0 = packed0.at[:, :, d + 1].set(-1)
+        # call-boundary invariant: accepted block = just the pending root
+        tks0 = jnp.zeros((R, d + 1), jnp.int32).at[:, 0].set(tok)
+        nblk0 = jnp.ones((R,), jnp.int32)
+        base0 = pos
+
+        def live_mask(base, nblk, remaining):
+            r_pos = base + nblk - 1
+            return ((remaining > 0) & (r_pos + B * d < max_seq))
+
+        def cond(carry):
+            i, _ls, _ss, _tks, nblk, base, remaining, act, _p = carry
+            return (i < n_rounds) & jnp.any(
+                act & live_mask(base, nblk, remaining))
+
+        def body(carry):
+            (i, llm_state, ssm_states, tks, nblk, base, remaining, act,
+             packed) = carry
+            act_i = act & live_mask(base, nblk, remaining)
+            (llm_state, ssm_states, blk, new_nblk, new_base, chain, n_acc,
+             bonus) = self._round(
+                llm_params, llm_state, ssm_ps, list(ssm_states), tks, nblk,
+                base, act_i, jax.random.fold_in(rng0, i))
+            tks = jnp.where(act_i[:, None], blk, tks)
+            nblk = jnp.where(act_i, new_nblk, nblk)
+            base = jnp.where(act_i, new_base, base)
+            remaining = remaining - jnp.where(act_i, n_acc + 1, 0)
+            row = jnp.concatenate(
+                [chain, bonus[:, None],
+                 jnp.where(act_i, n_acc, -1)[:, None]], axis=1)
+            packed = jax.lax.dynamic_update_slice(
+                packed, row[:, None, :], (0, i, 0))
+            return (i + 1, llm_state, tuple(ssm_states), tks, nblk, base,
+                    remaining, act, packed)
+
+        (_, llm_state, ssm_states, _, _, _, _, _, packed) = \
+            jax.lax.while_loop(
+                cond, body,
+                (jnp.int32(0), llm_state, tuple(ssm_states), tks0, nblk0,
+                 base0, remaining, active, packed0))
+        return (llm_state, tuple(ssm_states), packed)
+
+    def run_block(self, tok: np.ndarray, pos: np.ndarray, active: np.ndarray,
+                  n_rounds: int, remaining: Optional[np.ndarray] = None
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+        """Run up to ``n_rounds`` fused tree rounds. Returns (toks, n_acc):
+        toks[r, k] holds round k's [chain tokens (depth), bonus]; the
+        committed tokens are ``toks[r, k, :n_acc[r, k]]`` plus the bonus at
+        the FIXED index ``toks[r, k, depth]``; n_acc == -1 marks an idle
+        round."""
+        n_rounds = min(int(n_rounds), self.max_rounds)
+        if remaining is None:
+            remaining = np.full(tok.shape, np.iinfo(np.int32).max // 2,
+                                np.int32)
+        args = [self.llm.params, self.llm.op_state]
+        for s in self.ssms:
+            args += [s.params, s.op_state]
+        args += [jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(active),
+                 jnp.int32(n_rounds), jnp.asarray(remaining, jnp.int32)]
+        llm_state, ssm_states, packed = self._block(*args)
+        self.llm.op_state = llm_state
+        for s, st in zip(self.ssms, ssm_states):
+            s.op_state = st
+        packed = np.asarray(packed)
+        return packed[:, :, :-1], packed[:, :, -1]
+
+
 class SpecChainEngine:
     """Fused chain speculation: one device call per block of rounds.
 
@@ -182,8 +459,10 @@ class SpecChainEngine:
         a = out.astype(jnp.int32)                               # [R, d+1]
 
         # --- greedy acceptance: longest prefix where chain matches ---
+        # (= index of the first mismatch; see MultiSpecEngine on cumprod)
         match = (chain == a[:, :d]).astype(jnp.int32)
-        n_acc = jnp.cumprod(match, axis=1).sum(axis=1)          # [R] in [0,d]
+        n_acc = jnp.argmin(jnp.pad(match, ((0, 0), (0, 1))),
+                           axis=1).astype(jnp.int32)            # [R] in [0,d]
         bonus = jnp.take_along_axis(a, n_acc[:, None], axis=1)[:, 0]
         new_tok = bonus.astype(jnp.int32)
         new_pos = pos + n_acc + 1

@@ -350,6 +350,13 @@ class RequestManager:
             # merge and no KV compaction.
             return self._generate_spec_chain(llm, ssms[0],
                                              spec_depth=spec_depth)
+        if not llm.config.inference_debugging:
+            # multi-SSM trees also run fully fused (engine.MultiSpecEngine:
+            # all drafts + tree verify + acceptance + KV compaction inside
+            # one device while_loop); the host-stepped path below remains
+            # for inference_debugging's per-op tensor dumps.
+            return self._generate_spec_tree_fused(llm, ssms,
+                                                  spec_depth=spec_depth)
         llm_ifm = getattr(llm, "_inference_manager", None)
         if llm_ifm is None:
             llm_ifm = llm._inference_manager = InferenceManager(llm)
@@ -577,6 +584,150 @@ class RequestManager:
                     active[slot] = None
         return done
 
+    def _generate_spec_tree_fused(self, llm, ssms: List[Any],
+                                  spec_depth: Optional[int] = None
+                                  ) -> List[GenerationResult]:
+        """Multi-SSM tree speculation with the fused MultiSpecEngine.
+
+        Host responsibilities shrink to continuous batching: slot fill,
+        chunked prefill (verifier + every draft), dispatching fused round
+        blocks, and EOS/length reconciliation over the returned rounds —
+        the same division of labor as the single-SSM chain path.
+
+        NOTE: this loop intentionally parallels _generate_spec_chain (the
+        differences are real — per-SSM room/prefill, tree staging needs
+        B*depth+1 KV slots vs depth+1, and the packed-row format differs);
+        a scheduling/EOS fix in one path almost certainly applies to the
+        other — keep them in sync.
+        """
+        from flexflow_tpu.serve.engine import MultiSpecEngine
+
+        llm_ifm = getattr(llm, "_inference_manager", None)
+        if llm_ifm is None:
+            llm_ifm = llm._inference_manager = InferenceManager(llm)
+        ssm_ifms = []
+        for ssm in ssms:
+            m = getattr(ssm, "_inference_manager", None)
+            if m is None:
+                m = ssm._inference_manager = InferenceManager(ssm)
+            ssm_ifms.append(m)
+        cfg = llm.config
+        R = cfg.max_requests_per_batch
+        max_seq = cfg.max_sequence_length
+        B = len(ssms)
+        depth = min(spec_depth or self.max_spec_depth, self.max_spec_depth)
+        engine = getattr(llm, "_multi_engine", None)
+        if (engine is None or [s for s in engine.ssms] != list(ssms)
+                or engine.depth != depth):
+            engine = llm._multi_engine = MultiSpecEngine(
+                llm, ssms, depth, max_rounds=cfg.spec_rounds_per_call)
+        chunk = max(1, cfg.max_tokens_per_batch // max(1, min(R, 4)))
+        active: List[Optional[Request]] = [None] * R
+        done: List[GenerationResult] = []
+        # a request can draft only with a full tree of KV room left
+        room_needed = B * depth + 1
+
+        while self.pending or any(a is not None for a in active):
+            self._fill_slots(active, max_seq, done)
+            prefilled = False
+            rows = self._prefill_rows(active, chunk, lambda r: r.cache_depth,
+                                      cfg.max_tokens_per_batch)
+            if rows:
+                meta = self._meta_from_rows(R, chunk, rows)
+                llm_ifm.step(meta, want_output=False)
+                for slot, toks, sp in rows:
+                    active[slot].cache_depth = sp + len(toks)
+                prefilled = True
+            for i, ifm in enumerate(ssm_ifms):
+                rows = self._prefill_rows(
+                    active, chunk, lambda r, i=i: r.ssm_cache_depth.get(i, 0),
+                    cfg.max_tokens_per_batch)
+                rows = [(slot, toks, sp) for slot, toks, sp in rows
+                        if max_seq - len(active[slot].tokens)
+                        >= room_needed]
+                if rows:
+                    meta = self._meta_from_rows(R, chunk, rows)
+                    ifm.step(meta, want_output=False)
+                    for slot, toks, sp in rows:
+                        active[slot].ssm_cache_depth[i] = sp + len(toks)
+                    prefilled = True
+            if prefilled:
+                continue
+            live = [req for req in active
+                    if req is not None and not req.finished]
+            if not live:
+                continue
+            draftable = [req for req in live
+                         if max_seq - len(req.tokens) >= room_needed]
+            cramped = [req for req in live
+                       if max_seq - len(req.tokens) < room_needed]
+            if cramped:
+                # cache nearly full: finish token by token (chain-path
+                # parity; the fused tree needs B*depth+1 staging slots)
+                rows = [(req.slot, req.tokens[-1:], len(req.tokens) - 1)
+                        for req in cramped]
+                meta = self._meta_from_rows(R, 1, rows)
+                out = llm_ifm.step(meta)
+                for slot, _t, sp in rows:
+                    req = active[slot]
+                    req.tokens.append(int(out[slot, 0]))
+                    req.cache_depth = sp + 1
+                    for i in range(B):
+                        req.ssm_cache_depth[i] = min(
+                            req.ssm_cache_depth.get(i, 0), sp)
+                    self._finish_if_done(req, max_seq)
+            if draftable:
+                tok = np.zeros((R,), np.int32)
+                pos = np.zeros((R,), np.int32)
+                act = np.zeros((R,), bool)
+                remaining = np.zeros((R,), np.int32)
+                for req in draftable:
+                    assert req.cache_depth == len(req.tokens) - 1
+                    for i in range(B):
+                        assert req.ssm_cache_depth.get(i, 0) \
+                            == len(req.tokens) - 1, (i, req.ssm_cache_depth)
+                    tok[req.slot] = req.tokens[-1]
+                    pos[req.slot] = len(req.tokens) - 1
+                    act[req.slot] = True
+                    remaining[req.slot] = self._remaining_budget(req, max_seq)
+                rounds = min(cfg.spec_rounds_per_call, engine.max_rounds)
+                toks, n_acc = engine.run_block(tok, pos, act, rounds,
+                                               remaining)
+                for req in draftable:
+                    last_rpos = len(req.tokens) - 1
+                    for k in range(rounds):
+                        n = int(n_acc[req.slot, k])
+                        if n < 0:
+                            continue
+                        last_rpos = len(req.tokens) - 1
+                        new_toks = ([int(t) for t in toks[req.slot, k, :n]]
+                                    + [int(toks[req.slot, k, depth])])
+                        room = req.max_new_tokens - req.num_generated
+                        new_toks = new_toks[:max(0, room)]
+                        if (self.eos_token_id is not None
+                                and self.eos_token_id in new_toks):
+                            new_toks = new_toks[
+                                :new_toks.index(self.eos_token_id) + 1]
+                        req.tokens.extend(new_toks)
+                        if self._finish_if_done(req, max_seq):
+                            break
+                    d = len(req.tokens) - 1
+                    # verifier cache: committed in-engine through the last
+                    # accepted prefix (count = all but the pending token)
+                    req.cache_depth = d
+                    for i in range(B):
+                        # draft caches are only guaranteed correct through
+                        # the last round's catch-up position: a losing
+                        # branch's cache holds ITS chain, not the committed
+                        # tokens — the next prefill cycle feeds the gap
+                        req.ssm_cache_depth[i] = min(last_rpos + 1, d)
+            for slot in range(R):
+                req = active[slot]
+                if req is not None and req.finished:
+                    done.append(self._collect(req))
+                    active[slot] = None
+        return done
+
     def _draft_chains(self, ifm, ssm_idx, live, R, depth):
         """Greedy depth-``depth`` chain per live request on one SSM.
 
@@ -622,7 +773,8 @@ class RequestManager:
             # the chain commits the pending token's KV (+1); drafted tokens
             # beyond it are tentative — cache entries past the accepted
             # point are overwritten next round, so bookkeeping stays at d+1
-            req.ssm_cache_depth[ssm_idx] += 1
+            req.ssm_cache_depth[ssm_idx] = \
+                req.ssm_cache_depth.get(ssm_idx, 0) + 1
         return chains
 
     def _draft_chains_debug(self, ifm, ssm_idx, live, R, depth):

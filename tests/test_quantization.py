@@ -100,3 +100,22 @@ def test_quantized_serving_generates():
     matches = sum(a == b for a, b in
                   zip(res.output_tokens, full.output_tokens))
     assert matches >= 6, (res.output_tokens, full.output_tokens)
+
+
+def test_qtake_matches_dequantized_gather():
+    """qtake (packed-row gather, int4 nibble select) must equal gathering
+    from the fully dequantized table."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from flexflow_tpu.quant import dequantize_array, qtake, quantize_array
+
+    rng = np.random.RandomState(0)
+    table = jnp.asarray(rng.randn(31, 16).astype(np.float32))
+    ids = jnp.asarray(rng.randint(0, 31, size=(4, 5)).astype(np.int32))
+    for qtype in ("int8", "int4"):
+        qt = quantize_array(table, qtype)
+        got = qtake(qt, ids)
+        want = jnp.take(dequantize_array(qt), ids, axis=0)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)

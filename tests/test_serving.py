@@ -277,3 +277,61 @@ def test_spec_infer_multi_ssm_tree_near_limit():
     rm2.register_new_request(prompt, max_new_tokens=20)
     (spec,) = rm2.generate_spec_infer(llm, [ssm1, ssm2], spec_depth=4)
     assert spec.output_tokens == incr.output_tokens
+
+
+def test_multi_ssm_spec_host_calls_bounded():
+    """Multi-SSM tree speculation must be FUSED: the number of host->device
+    dispatches for a whole generation must not scale with drafted tokens
+    (the pre-fusion path paid one InferenceManager.step per drafted token
+    per SSM per round and could never beat incremental decoding — the
+    reference CI speed gate compare_speed_spec_infer_incr_decoding,
+    python_inference_tests.sh:57, is asserted wall-clock on the bench
+    harness: ``python bench.py --multi-ssm`` on the real chip)."""
+    from flexflow_tpu.serve.engine import MultiSpecEngine
+    from flexflow_tpu.serve.inference_manager import InferenceManager
+
+    deep = LLAMAConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                       num_hidden_layers=4, num_attention_heads=4,
+                       num_key_value_heads=2, max_position_embeddings=128)
+
+    def build(mode, layers):
+        cfg = ff.FFConfig(max_requests_per_batch=4, max_sequence_length=128,
+                          max_tokens_per_batch=16, seed=3,
+                          kv_cache_dtype="float32")
+        m = ff.FFModel(cfg)
+        mc = LLAMAConfig(**{**deep.__dict__, "num_hidden_layers": layers})
+        create_llama_model(m, mc, mode=mode)
+        m.compile(comp_mode=ff.CompMode.COMP_MODE_INFERENCE)
+        return m
+
+    llm = build(InferenceMode.TREE_VERIFY_MODE, 4)
+    ssms = [build(InferenceMode.BEAM_SEARCH_MODE, 1) for _ in range(2)]
+
+    calls = {"step": 0, "block": 0}
+    orig_step = InferenceManager.step
+    orig_block = MultiSpecEngine.run_block
+
+    def step_counted(self, *a, **k):
+        calls["step"] += 1
+        return orig_step(self, *a, **k)
+
+    def block_counted(self, *a, **k):
+        calls["block"] += 1
+        return orig_block(self, *a, **k)
+
+    InferenceManager.step = step_counted
+    MultiSpecEngine.run_block = block_counted
+    try:
+        rm = RequestManager()
+        for p in [[5, 9, 23, 44], [7, 3], [2, 8, 9], [11]]:
+            rm.register_new_request(p, max_new_tokens=40)
+        res = rm.generate_spec_infer(llm, ssms, spec_depth=3)
+    finally:
+        InferenceManager.step = orig_step
+        MultiSpecEngine.run_block = orig_block
+    assert sum(len(r.output_tokens) for r in res) >= 4 * 40
+    # 160 generated tokens over ~45 tree rounds; the unfused path paid
+    # ~rounds*(n_ssm*depth+1) ~ 300+ host dispatches. Fused: blocks of
+    # spec_rounds_per_call (default 4) rounds + a few prefill/heal steps.
+    assert calls["block"] <= 14, calls
+    assert calls["step"] <= 16, calls
