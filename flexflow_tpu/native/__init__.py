@@ -33,7 +33,8 @@ _build_failed = False
 def _sources():
     src = os.path.join(_NATIVE_DIR, "src")
     return [os.path.join(src, f) for f in
-            ("bpe_tokenizer.cpp", "batch_scheduler.cpp")]
+            ("bpe_tokenizer.cpp", "batch_scheduler.cpp",
+             "sp_tokenizer.cpp")]
 
 
 def _needs_build() -> bool:

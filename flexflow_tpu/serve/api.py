@@ -113,11 +113,27 @@ class LLM:
             self.hf_config = hf.config
             self._state_dict = hf.state_dict()
             if self.tokenizer is None:
-                try:
-                    self.tokenizer = transformers.AutoTokenizer.from_pretrained(
-                        model, local_files_only=local)
-                except Exception:
-                    self.tokenizer = None
+                sp_path = os.path.join(model, "tokenizer.model")
+                if local and os.path.exists(sp_path):
+                    # LLaMA-family SentencePiece model: the native tokenizer
+                    # (native/src/sp_tokenizer.cpp) keeps transformers off
+                    # the tokenize path entirely (reference: tokenizers-cpp
+                    # selected by ModelType, request_manager.cc:109)
+                    try:
+                        from flexflow_tpu.native.sp_tokenizer import \
+                            SentencePieceTokenizer
+
+                        self.tokenizer = SentencePieceTokenizer(sp_path)
+                    except Exception:
+                        self.tokenizer = None   # corrupt model file: raw
+                        # token-id prompts still work (pre-existing contract)
+                if self.tokenizer is None:
+                    try:
+                        self.tokenizer = \
+                            transformers.AutoTokenizer.from_pretrained(
+                                model, local_files_only=local)
+                    except Exception:
+                        self.tokenizer = None
         else:
             raise TypeError(f"unsupported model source: {type(model)}")
         self.family = family_for_hf_config(self.hf_config)

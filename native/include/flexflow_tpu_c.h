@@ -97,6 +97,24 @@ int ffs_done_tokens(void *handle, int64_t guid, int32_t *out, int cap);
 /* Number of prompt tokens for a request (for output splitting). */
 int ffs_prompt_len(void *handle, int64_t guid);
 
+
+/* ---------------- SentencePiece tokenizer (LLaMA family) ----------------
+ * Reference: tokenizers-cpp selected by ModelType in
+ * request_manager.cc:109; here native/src/sp_tokenizer.cpp. */
+void *ffsp_create(const char *model_path);
+void *ffsp_create_from_buffer(const uint8_t *data, int n);
+void ffsp_destroy(void *handle);
+int ffsp_vocab_size(void *handle);
+int ffsp_model_type(void *handle);           /* 1=unigram 2=bpe */
+int ffsp_bos_id(void *handle);
+int ffsp_eos_id(void *handle);
+int ffsp_unk_id(void *handle);
+int ffsp_encode(void *handle, const char *text, int text_len,
+                int32_t *out_ids, int cap);  /* returns total ids */
+int ffsp_decode(void *handle, const int32_t *ids, int n, char *out,
+                int cap);                    /* returns total bytes */
+int ffsp_piece_to_id(void *handle, const char *piece);
+
 #ifdef __cplusplus
 }
 #endif

@@ -1,0 +1,475 @@
+// SentencePiece tokenizer (unigram + BPE), dependency-free.
+//
+// Capability parity with the reference's bundled tokenizers-cpp, which the
+// RequestManager selects for LLaMA-family models (reference
+// src/runtime/request_manager.cc:109 picks a SentencePiece tokenizer by
+// ModelType). Fresh implementation: a minimal protobuf wire-format reader
+// for sentencepiece_model.proto (ModelProto{pieces=1{piece=1,score=2,
+// type=3}, trainer_spec=2{model_type=3, byte_fallback=35, unk_id=40,
+// bos_id=41, eos_id=42}, normalizer_spec=3{add_dummy_prefix=3,
+// remove_extra_whitespaces=4, escape_whitespaces=5}}), unigram Viterbi
+// segmentation with byte fallback, and greedy score-ordered BPE merging.
+// The Python twin in flexflow_tpu/native/sp_tokenizer.py implements the
+// same algorithms and is the parity oracle in tests/test_native.py.
+
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <fstream>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ----------------------------- proto wire -----------------------------
+struct Reader {
+  const uint8_t *p;
+  const uint8_t *end;
+  bool ok = true;
+
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end) {
+      uint8_t b = *p++;
+      v |= uint64_t(b & 0x7F) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+      if (shift > 63) break;
+    }
+    ok = false;
+    return 0;
+  }
+
+  bool next(uint32_t *fnum, uint32_t *wtype) {
+    if (p >= end || !ok) return false;
+    uint64_t key = varint();
+    if (!ok) return false;
+    *fnum = uint32_t(key >> 3);
+    *wtype = uint32_t(key & 7);
+    return true;
+  }
+
+  // returns a sub-range for length-delimited fields
+  Reader sub() {
+    uint64_t n = varint();
+    // compare against the remaining size, NOT p + n: a corrupt file can
+    // carry a near-2^64 length whose pointer addition wraps past the
+    // bounds check and walks out of the buffer
+    if (!ok || n > uint64_t(end - p)) {
+      ok = false;
+      return {end, end};
+    }
+    Reader r{p, p + n};
+    p += n;
+    return r;
+  }
+
+  void skip(uint32_t wtype) {
+    switch (wtype) {
+      case 0: varint(); break;
+      case 1: p += 8; break;
+      case 2: sub(); break;
+      case 5: p += 4; break;
+      default: ok = false;
+    }
+    if (p > end) ok = false;
+  }
+
+  float f32() {
+    if (p + 4 > end) {
+      ok = false;
+      return 0.f;
+    }
+    float v;
+    std::memcpy(&v, p, 4);
+    p += 4;
+    return v;
+  }
+};
+
+// piece types (sentencepiece_model.proto SentencePiece::Type)
+enum PieceType { NORMAL = 1, UNKNOWN = 2, CONTROL = 3, USER_DEFINED = 4,
+                 UNUSED = 5, BYTE = 6 };
+
+constexpr const char *kWsPiece = "\xE2\x96\x81";  // U+2581 LOWER ONE EIGHTH
+constexpr float kUnkPenalty = 10.0f;
+
+struct SpModel {
+  std::vector<std::string> pieces;
+  std::vector<float> scores;
+  std::vector<int> types;
+  std::unordered_map<std::string, int> piece_to_id;
+  int model_type = 1;  // 1=UNIGRAM 2=BPE
+  bool byte_fallback = false;
+  int unk_id = 0, bos_id = 1, eos_id = 2;
+  bool add_dummy_prefix = true;
+  bool remove_extra_ws = true;
+  bool escape_ws = true;
+  int byte_id[256];
+  float min_score = 0.f;
+  size_t max_piece_len = 1;
+
+  void finish() {
+    for (int i = 0; i < 256; i++) byte_id[i] = -1;
+    min_score = 0.f;
+    for (size_t i = 0; i < pieces.size(); i++) {
+      piece_to_id.emplace(pieces[i], int(i));
+      if (types[i] == NORMAL && scores[i] < min_score) min_score = scores[i];
+      if (pieces[i].size() > max_piece_len) max_piece_len = pieces[i].size();
+      if (types[i] == BYTE && pieces[i].size() == 6) {
+        // "<0xAB>"
+        int hi = -1, lo = -1;
+        auto hex = [](char c) {
+          if (c >= '0' && c <= '9') return c - '0';
+          if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+          if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+          return -1;
+        };
+        hi = hex(pieces[i][3]);
+        lo = hex(pieces[i][4]);
+        if (hi >= 0 && lo >= 0) byte_id[hi * 16 + lo] = int(i);
+      }
+    }
+  }
+};
+
+bool parse_model(const uint8_t *data, size_t n, SpModel *m) {
+  Reader r{data, data + n};
+  uint32_t f, w;
+  while (r.next(&f, &w)) {
+    if (f == 1 && w == 2) {  // pieces
+      Reader pr = r.sub();
+      std::string piece;
+      float score = 0.f;
+      int type = NORMAL;
+      uint32_t pf, pw;
+      while (pr.next(&pf, &pw)) {
+        if (pf == 1 && pw == 2) {
+          Reader s = pr.sub();
+          piece.assign(reinterpret_cast<const char *>(s.p), s.end - s.p);
+        } else if (pf == 2 && pw == 5) {
+          score = pr.f32();
+        } else if (pf == 3 && pw == 0) {
+          type = int(pr.varint());
+        } else {
+          pr.skip(pw);
+        }
+      }
+      m->pieces.push_back(piece);
+      m->scores.push_back(score);
+      m->types.push_back(type);
+    } else if (f == 2 && w == 2) {  // trainer_spec
+      Reader tr = r.sub();
+      uint32_t tf, tw;
+      while (tr.next(&tf, &tw)) {
+        if (tf == 3 && tw == 0) m->model_type = int(tr.varint());
+        else if (tf == 35 && tw == 0) m->byte_fallback = tr.varint() != 0;
+        else if (tf == 40 && tw == 0) m->unk_id = int(tr.varint());
+        else if (tf == 41 && tw == 0) m->bos_id = int(tr.varint());
+        else if (tf == 42 && tw == 0) m->eos_id = int(tr.varint());
+        else tr.skip(tw);
+      }
+    } else if (f == 3 && w == 2) {  // normalizer_spec
+      Reader nr = r.sub();
+      uint32_t nf, nw;
+      while (nr.next(&nf, &nw)) {
+        if (nf == 3 && nw == 0) m->add_dummy_prefix = nr.varint() != 0;
+        else if (nf == 4 && nw == 0) m->remove_extra_ws = nr.varint() != 0;
+        else if (nf == 5 && nw == 0) m->escape_ws = nr.varint() != 0;
+        else nr.skip(nw);
+      }
+    } else {
+      r.skip(w);
+    }
+  }
+  if (!r.ok || m->pieces.empty()) return false;
+  m->finish();
+  return true;
+}
+
+// --------------------------- normalization ----------------------------
+std::string normalize(const SpModel &m, const std::string &in) {
+  std::string s = in;
+  if (m.remove_extra_ws) {
+    std::string t;
+    size_t a = 0, b = s.size();
+    while (a < b && s[a] == ' ') a++;
+    while (b > a && s[b - 1] == ' ') b--;
+    bool prev_ws = false;
+    for (size_t i = a; i < b; i++) {
+      if (s[i] == ' ') {
+        if (!prev_ws) t.push_back(' ');
+        prev_ws = true;
+      } else {
+        t.push_back(s[i]);
+        prev_ws = false;
+      }
+    }
+    s = t;
+  }
+  if (m.add_dummy_prefix) s = " " + s;
+  if (m.escape_ws) {
+    std::string t;
+    for (char c : s) {
+      if (c == ' ') t += kWsPiece;
+      else t.push_back(c);
+    }
+    s = t;
+  }
+  return s;
+}
+
+size_t utf8_len(uint8_t b) {
+  if (b < 0x80) return 1;
+  if ((b & 0xE0) == 0xC0) return 2;
+  if ((b & 0xF0) == 0xE0) return 3;
+  if ((b & 0xF8) == 0xF0) return 4;
+  return 1;  // invalid byte: treat as one unit
+}
+
+void emit_with_fallback(const SpModel &m, const std::string &seg,
+                        std::vector<int32_t> *out) {
+  if (m.byte_fallback) {
+    bool all = true;
+    for (unsigned char c : seg)
+      if (m.byte_id[c] < 0) all = false;
+    if (all) {
+      for (unsigned char c : seg) out->push_back(m.byte_id[c]);
+      return;
+    }
+  }
+  out->push_back(m.unk_id);
+}
+
+// --------------------------- unigram Viterbi ---------------------------
+void encode_unigram(const SpModel &m, const std::string &s,
+                    std::vector<int32_t> *out) {
+  size_t n = s.size();
+  if (n == 0) return;
+  // char boundaries
+  std::vector<size_t> starts;
+  std::vector<char> is_start(n + 1, 0);
+  for (size_t i = 0; i < n;) {
+    starts.push_back(i);
+    is_start[i] = 1;
+    i += utf8_len(uint8_t(s[i]));
+  }
+  is_start[n] = 1;
+  const float NEG = -1e30f;
+  std::vector<float> best(n + 1, NEG);
+  std::vector<int> prev(n + 1, -1);     // previous boundary
+  std::vector<int> piece(n + 1, -1);    // piece id ending here (-2 => unk)
+  best[0] = 0.f;
+  float unk_score = m.min_score - kUnkPenalty;
+  for (size_t i = 0; i <= n; i++) {
+    if (!is_start[i] || best[i] <= NEG) continue;
+    if (i == n) break;
+    size_t cl = utf8_len(uint8_t(s[i]));
+    // unk/byte-fallback single char
+    size_t ce = i + cl > n ? n : i + cl;
+    if (best[i] + unk_score > best[ce]) {
+      best[ce] = best[i] + unk_score;
+      prev[ce] = int(i);
+      piece[ce] = -2;
+    }
+    size_t maxl = m.max_piece_len;
+    for (size_t e = i + 1; e <= n && e - i <= maxl; e++) {
+      if (!is_start[e]) continue;
+      auto it = m.piece_to_id.find(s.substr(i, e - i));
+      if (it == m.piece_to_id.end()) continue;
+      int id = it->second;
+      if (m.types[id] != NORMAL && m.types[id] != USER_DEFINED) continue;
+      float sc = best[i] + m.scores[id];
+      if (sc > best[e]) {
+        best[e] = sc;
+        prev[e] = int(i);
+        piece[e] = id;
+      }
+    }
+  }
+  // backtrack
+  std::vector<std::pair<int, int>> segs;  // (start, piece or -2)
+  int cur = int(n);
+  while (cur > 0) {
+    if (prev[cur] < 0) return;  // unreachable; give up silently
+    segs.push_back({prev[cur], piece[cur]});
+    cur = prev[cur];
+  }
+  for (auto it = segs.rbegin(); it != segs.rend(); ++it) {
+    int st = it->first, id = it->second;
+    if (id >= 0) {
+      out->push_back(id);
+    } else {
+      size_t cl = utf8_len(uint8_t(s[st]));
+      emit_with_fallback(m, s.substr(st, cl), out);
+    }
+  }
+}
+
+// ---------------------------- greedy BPE -------------------------------
+void encode_bpe(const SpModel &m, const std::string &s,
+                std::vector<int32_t> *out) {
+  // symbols as [start, end) byte ranges over s
+  std::vector<std::pair<size_t, size_t>> sym;
+  for (size_t i = 0; i < s.size();) {
+    size_t l = utf8_len(uint8_t(s[i]));
+    if (i + l > s.size()) l = s.size() - i;
+    sym.push_back({i, i + l});
+    i += l;
+  }
+  // iterate: merge the adjacent pair whose concatenation is a known piece
+  // with the highest score; leftmost wins ties (sentencepiece bpe_model)
+  while (sym.size() > 1) {
+    float best_score = -1e30f;
+    int best_i = -1;
+    for (size_t i = 0; i + 1 < sym.size(); i++) {
+      auto it = m.piece_to_id.find(
+          s.substr(sym[i].first, sym[i + 1].second - sym[i].first));
+      if (it == m.piece_to_id.end()) continue;
+      int id = it->second;
+      if (m.types[id] != NORMAL && m.types[id] != USER_DEFINED) continue;
+      if (m.scores[id] > best_score) {
+        best_score = m.scores[id];
+        best_i = int(i);
+      }
+    }
+    if (best_i < 0) break;
+    sym[best_i].second = sym[best_i + 1].second;
+    sym.erase(sym.begin() + best_i + 1);
+  }
+  for (auto &p : sym) {
+    auto it = m.piece_to_id.find(s.substr(p.first, p.second - p.first));
+    if (it != m.piece_to_id.end() &&
+        (m.types[it->second] == NORMAL ||
+         m.types[it->second] == USER_DEFINED)) {
+      out->push_back(it->second);
+    } else {
+      emit_with_fallback(m, s.substr(p.first, p.second - p.first), out);
+    }
+  }
+}
+
+std::string decode_ids(const SpModel &m, const int32_t *ids, int n) {
+  std::string out;
+  std::string pending_bytes;
+  auto flush = [&]() {
+    out += pending_bytes;
+    pending_bytes.clear();
+  };
+  for (int i = 0; i < n; i++) {
+    int id = ids[i];
+    if (id < 0 || size_t(id) >= m.pieces.size()) continue;
+    int t = m.types[id];
+    if (t == BYTE) {
+      const std::string &p = m.pieces[id];
+      int hi = 0, lo = 0;
+      auto hex = [](char c) {
+        if (c >= '0' && c <= '9') return c - '0';
+        if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+        if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+        return 0;
+      };
+      if (p.size() == 6) {
+        hi = hex(p[3]);
+        lo = hex(p[4]);
+        pending_bytes.push_back(char(hi * 16 + lo));
+      }
+      continue;
+    }
+    flush();
+    if (t == CONTROL || t == UNUSED) continue;
+    if (t == UNKNOWN) {
+      out += " \xE2\x81\x87 ";  // sentencepiece's default unk surface
+      continue;
+    }
+    out += m.pieces[id];
+  }
+  flush();
+  // unescape whitespace
+  std::string res;
+  if (m.escape_ws) {
+    for (size_t i = 0; i < out.size();) {
+      if (out.compare(i, 3, kWsPiece) == 0) {
+        res.push_back(' ');
+        i += 3;
+      } else {
+        res.push_back(out[i]);
+        i += 1;
+      }
+    }
+  } else {
+    res = out;
+  }
+  if (m.add_dummy_prefix && !res.empty() && res[0] == ' ')
+    res.erase(res.begin());
+  return res;
+}
+
+}  // namespace
+
+// ------------------------------- C API ---------------------------------
+extern "C" {
+
+void *ffsp_create_from_buffer(const uint8_t *data, int n) {
+  auto *m = new SpModel();
+  if (!parse_model(data, size_t(n), m)) {
+    delete m;
+    return nullptr;
+  }
+  return m;
+}
+
+void *ffsp_create(const char *path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return nullptr;
+  std::string buf((std::istreambuf_iterator<char>(f)),
+                  std::istreambuf_iterator<char>());
+  return ffsp_create_from_buffer(
+      reinterpret_cast<const uint8_t *>(buf.data()), int(buf.size()));
+}
+
+void ffsp_destroy(void *h) { delete static_cast<SpModel *>(h); }
+
+int ffsp_vocab_size(void *h) {
+  return int(static_cast<SpModel *>(h)->pieces.size());
+}
+
+int ffsp_model_type(void *h) {
+  return static_cast<SpModel *>(h)->model_type;
+}
+
+int ffsp_bos_id(void *h) { return static_cast<SpModel *>(h)->bos_id; }
+int ffsp_eos_id(void *h) { return static_cast<SpModel *>(h)->eos_id; }
+int ffsp_unk_id(void *h) { return static_cast<SpModel *>(h)->unk_id; }
+
+// returns number of ids (<= cap); extra ids are dropped
+int ffsp_encode(void *h, const char *text, int text_len, int32_t *out,
+                int cap) {
+  auto *m = static_cast<SpModel *>(h);
+  std::string norm = normalize(*m, std::string(text, size_t(text_len)));
+  std::vector<int32_t> ids;
+  if (m->model_type == 2) encode_bpe(*m, norm, &ids);
+  else encode_unigram(*m, norm, &ids);
+  int n = int(ids.size() < size_t(cap) ? ids.size() : size_t(cap));
+  std::memcpy(out, ids.data(), size_t(n) * sizeof(int32_t));
+  return int(ids.size());
+}
+
+// returns number of bytes written (<= cap); output NOT nul-terminated
+int ffsp_decode(void *h, const int32_t *ids, int n, char *out, int cap) {
+  auto *m = static_cast<SpModel *>(h);
+  std::string s = decode_ids(*m, ids, n);
+  int w = int(s.size() < size_t(cap) ? s.size() : size_t(cap));
+  std::memcpy(out, s.data(), size_t(w));
+  return int(s.size());
+}
+
+int ffsp_piece_to_id(void *h, const char *piece) {
+  auto *m = static_cast<SpModel *>(h);
+  auto it = m->piece_to_id.find(piece);
+  return it == m->piece_to_id.end() ? -1 : it->second;
+}
+
+}  // extern "C"
