@@ -1,0 +1,37 @@
+"""Broadcast elementwise multiply (reference
+examples/python/keras/elementwise_mul_broadcast.py: [B, N] * [B, 1])."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu.keras as keras
+from flexflow_tpu.keras.models import Model, Sequential
+from flexflow_tpu.keras.layers import (Activation, Add, Concatenate, Conv2D,
+                                       Dense, Dropout, Flatten, Input,
+                                       Maximum, Minimum, MaxPooling2D,
+                                       Multiply, Permute, Reshape)
+
+
+def top_level_task():
+    in0 = Input(shape=(32,))
+    in1 = Input(shape=(16,))
+    x = Dense(24, activation="relu")(in0)
+    gate = Dense(1, activation="sigmoid")(in1)   # [B, 1] broadcasts over 24
+    f = Multiply()([x, gate])
+    out = Dense(1)(f)
+    model = Model([in0, in1], out)
+    model.compile(optimizer=keras.optimizers.Adam(learning_rate=0.001),
+                  loss="mean_squared_error", metrics=[])
+    rng = np.random.RandomState(0)
+    model.fit([rng.randn(256, 32).astype(np.float32),
+               rng.randn(256, 16).astype(np.float32)],
+              rng.randn(256, 1).astype(np.float32), epochs=1)
+
+
+if __name__ == "__main__":
+    top_level_task()

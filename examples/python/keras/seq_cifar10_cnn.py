@@ -1,0 +1,41 @@
+"""Sequential CIFAR-10 CNN (reference examples/python/keras/seq_cifar10_cnn.py)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu.keras as keras
+from flexflow_tpu.keras.models import Model, Sequential
+from flexflow_tpu.keras.layers import (Activation, Add, Concatenate, Conv2D,
+                                       Dense, Dropout, Flatten, Input,
+                                       Maximum, Minimum, MaxPooling2D,
+                                       Multiply, Permute, Reshape)
+
+from flexflow_tpu.keras.datasets import cifar10
+
+
+def top_level_task():
+    (x_train, y_train), _ = cifar10.load_data(n_train=512)
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    model = Sequential()
+    model.add(Conv2D(32, (3, 3), activation="relu",
+                     input_shape=(3, 32, 32)))
+    model.add(Conv2D(32, (3, 3), activation="relu"))
+    model.add(MaxPooling2D((2, 2)))
+    model.add(Flatten())
+    model.add(Dense(128, activation="relu"))
+    model.add(Dense(10, activation="softmax"))
+    model.compile(optimizer=keras.optimizers.SGD(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x_train, y_train, epochs=1)
+
+
+if __name__ == "__main__":
+    top_level_task()

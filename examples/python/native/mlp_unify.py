@@ -1,0 +1,51 @@
+"""MLP_Unify: two parallel dense towers fused by elementwise add
+(reference examples/cpp/MLP_Unify/mlp.cc — the Unity paper's motivating
+two-tower MLP; hidden sizes scaled down).
+
+Run with the auto-parallel search: python examples/python/native/mlp_unify.py
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu as ff
+
+
+def top_level_task():
+    config = ff.FFConfig.from_args()
+    config.auto_parallel = True     # the Unity search picks the strategy
+    model = ff.FFModel(config)
+    B = config.batch_size
+    hidden = [256, 256, 256, 128]
+
+    in1 = model.create_tensor([B, 128], ff.DataType.DT_FLOAT)
+    in2 = model.create_tensor([B, 128], ff.DataType.DT_FLOAT)
+    t1, t2 = in1, in2
+    for i, h in enumerate(hidden):
+        act = (ff.ActiMode.AC_MODE_NONE if i + 1 == len(hidden)
+               else ff.ActiMode.AC_MODE_RELU)
+        t1 = model.dense(t1, h, act, use_bias=False)
+        t2 = model.dense(t2, h, act, use_bias=False)
+    t = model.add(t1, t2)
+    model.softmax(model.dense(t, 10))
+
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=config.learning_rate),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY])
+
+    rng = np.random.RandomState(0)
+    n = 8 * B
+    x1 = rng.randn(n, 128).astype(np.float32)
+    x2 = rng.randn(n, 128).astype(np.float32)
+    ys = rng.randint(0, 10, size=(n, 1)).astype(np.int32)
+    model.fit([x1, x2], ys, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

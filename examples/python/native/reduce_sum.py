@@ -1,0 +1,43 @@
+"""reduce_sum / unary-op demo over the builder API (reference
+examples/python/keras/{reduce_sum,rsqrt,unary}.py use backend internals;
+the native builder exposes the same ops directly).
+
+Run: python examples/python/native/reduce_sum.py [-b 32] [-e 1]
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu as ff
+
+
+def top_level_task():
+    config = ff.FFConfig.from_args()
+    model = ff.FFModel(config)
+    B = config.batch_size
+
+    t = model.create_tensor([B, 16, 8], ff.DataType.DT_FLOAT)
+    x = model.rsqrt(model.scalar_add(model.exp(model.identity(t)), 1.0))
+    x = model.reduce_sum(x, axes=[1])            # [B, 8]
+    x = model.relu(model.dense(x, 32))
+    model.softmax(model.dense(x, 4))
+
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=config.learning_rate),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY])
+
+    rng = np.random.RandomState(0)
+    n = 8 * B
+    xs = rng.randn(n, 16, 8).astype(np.float32)
+    ys = rng.randint(0, 4, size=(n, 1)).astype(np.int32)
+    model.fit(xs, ys, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

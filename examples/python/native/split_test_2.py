@@ -1,0 +1,43 @@
+"""split_test_2: explicit tensor split into parallel branches + concat
+(reference examples/cpp/split_test_2/split_test_2.cc).
+
+Run: python examples/python/native/split_test_2.py [-b 64] [-e 1]
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu as ff
+
+
+def top_level_task():
+    config = ff.FFConfig.from_args()
+    model = ff.FFModel(config)
+    B = config.batch_size
+
+    t = model.create_tensor([B, 256], ff.DataType.DT_FLOAT)
+    x = model.relu(model.dense(t, 128))
+    parts = model.split(x, 2, axis=1)           # two [B, 64] halves
+    heads = [model.relu(model.dense(p, 32)) for p in parts]
+    x = model.concat(heads, axis=1)
+    model.softmax(model.dense(x, 10))
+
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=config.learning_rate),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY])
+
+    rng = np.random.RandomState(0)
+    n = 8 * B
+    xs = rng.randn(n, 256).astype(np.float32)
+    ys = rng.randint(0, 10, size=(n, 1)).astype(np.int32)
+    model.fit(xs, ys, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,60 @@
+"""XDL: sparse-embedding + MLP click-through model (reference
+examples/cpp/XDL/xdl.cc — embedding bags over four 1M-entry tables, a
+bottom MLP on dense features, interaction by concat, top MLP to 2-way
+output; sizes scaled down for the synthetic-data run).
+
+Run: python examples/python/native/xdl.py [-b 32] [-e 1]
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu as ff
+
+
+def create_mlp(model, t, dims, sigmoid_layer=-1):
+    for i, d in enumerate(dims):
+        act = (ff.ActiMode.AC_MODE_SIGMOID if i == sigmoid_layer
+               else ff.ActiMode.AC_MODE_RELU)
+        t = model.dense(t, d, act, use_bias=False)
+    return t
+
+
+def top_level_task():
+    config = ff.FFConfig.from_args()
+    model = ff.FFModel(config)
+    B = config.batch_size
+    n_tables, table_size, sparse_dim = 4, 1000, 16
+
+    dense_in = model.create_tensor([B, 16], ff.DataType.DT_FLOAT)
+    sparse_ins = [model.create_tensor([B, 1], ff.DataType.DT_INT32)
+                  for _ in range(n_tables)]
+    embs = [model.embedding(s, table_size, sparse_dim,
+                            aggr=ff.AggrMode.AGGR_MODE_SUM)
+            for s in sparse_ins]
+    bottom = create_mlp(model, dense_in, [64, sparse_dim])
+    x = model.concat(embs + [bottom], axis=1)
+    out = create_mlp(model, x, [64, 64, 2], sigmoid_layer=2)
+    model.softmax(out)
+
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=config.learning_rate),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY])
+
+    rng = np.random.RandomState(0)
+    n = 8 * B
+    dense = rng.randn(n, 16).astype(np.float32)
+    sparse = [rng.randint(0, table_size, size=(n, 1)).astype(np.int32)
+              for _ in range(n_tables)]
+    ys = rng.randint(0, 2, size=(n, 1)).astype(np.int32)
+    model.fit([dense] + sparse, ys, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -84,6 +84,11 @@ class FFConfig:
     # at the cost of more overshoot past EOS.
     decode_block_steps: int = 8
     spec_rounds_per_call: int = 4
+    # draft beam width (reference BeamSearchBatchConfig::MAX_BEAM_WIDTH,
+    # batch_config.h:125; default 1 = greedy chains). Width > 1 makes a
+    # BEAM_SEARCH-mode model emit per-step top-k (prob, id) pairs and the
+    # RequestManager run beam-search drafting over the token tree.
+    max_beam_width: int = 1
 
     # --- serving / offload / quantization (reference config.h:144-163) ---
     cpu_offload: bool = False

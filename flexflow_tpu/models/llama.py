@@ -96,6 +96,16 @@ def create_llama_model(model, config: LLAMAConfig,
     gen = generation_config or GenerationConfig()
     if gen.do_sample and mode == InferenceMode.INC_DECODING_MODE:
         out = model.sampling(logits, top_p=gen.topp, temperature=gen.temperature)
+    elif (mode == InferenceMode.BEAM_SEARCH_MODE
+          and ffc.max_beam_width > 1):
+        # beam drafting emits per-node top-k (prob, id) pairs (reference
+        # llama.cc builds beam_top_k in beam mode); packed into ONE tensor
+        # [..., 2k] = [probs, ids-as-float] so the serving step returns a
+        # single output (ids < 2^24 are exact in f32)
+        w = ffc.max_beam_width
+        probs, ids = model.arg_top_k(logits, k=w, speculative_decoding=True)
+        ids_f = model.cast(ids, DataType.DT_FLOAT)
+        out = model.concat([probs, ids_f], axis=-1)
     else:
         out = model.argmax(logits)
     return out

@@ -329,6 +329,13 @@ class IncMultiHeadSelfAttention(OpImpl):
         x = inputs[0]
         meta = ctx.batch_config
         assert meta is not None, "serving ops need ctx.batch_config"
+        if hasattr(meta, "ancestor"):
+            # beam-width>1 drafting stages the frontier as tree nodes on
+            # the DRAFT model too (reference spec_inc_multihead_self_
+            # attention.cu keeps per-beam KV; tree attention over the
+            # staged region subsumes it with no cache duplication)
+            return TreeIncMultiHeadSelfAttention.forward(attrs, params,
+                                                         inputs, ctx)
         q, k, v = _qkv(attrs, params, x, ctx.compute_dtype)
         if attrs.get("apply_rotary_embedding", False):
             cos, sin = rotary_cos_sin(meta.positions, attrs["head_dim"],

@@ -334,16 +334,37 @@ class RequestManager:
     # Speculative inference (reference generate_spec_infer :1867)
     # =====================================================================
     def generate_spec_infer(self, llm, ssms: List[Any],
-                            spec_depth: Optional[int] = None
+                            spec_depth: Optional[int] = None,
+                            beam_width: Optional[int] = None
                             ) -> List[GenerationResult]:
         """LLM verifies token trees proposed by draft SSMs.
 
-        Each SSM proposes a depth-``spec_depth`` greedy chain per request;
-        chains are merged into one token tree (shared prefixes dedup — the
-        reference's merge_dfs_trees, request_manager.cc); the LLM scores all
-        tree nodes in one step; the longest root path whose every child
-        matches the verifier's argmax is accepted, plus one bonus token.
+        Each SSM proposes a depth-``spec_depth`` token tree per request:
+        greedy chains at beam_width 1, or a ``beam_width``-wide beam search
+        (reference BeamSearchBatchConfig, batch_config.h:125); trees are
+        merged (shared prefixes dedup — the reference's merge_dfs_trees,
+        request_manager.cc); the LLM scores all tree nodes in one step; the
+        longest root path whose every child matches the verifier's argmax
+        is accepted, plus one bonus token.
         """
+        widths = [s.config.max_beam_width for s in ssms]
+        W = beam_width or max(widths)
+        if any(w != W for w in widths):
+            # a BEAM_SEARCH-mode graph's output layout is fixed by the
+            # width it was COMPILED with (packed [top-k probs, top-k ids]
+            # at width>1, argmax ids at width 1) — a mismatched request
+            # would silently misparse the packing
+            raise ValueError(
+                f"beam_width={W} but the draft models were compiled with "
+                f"max_beam_width={widths}; rebuild the SSMs with the "
+                f"requested width (FFConfig.max_beam_width)")
+        if W > 1:
+            # beam drafting runs the host tree path: frontier nodes step
+            # through the draft as STAGED TREE NODES (no per-beam KV), and
+            # the surviving beam paths merge like extra chains
+            return self._generate_spec_tree_host(llm, ssms,
+                                                 spec_depth=spec_depth,
+                                                 beam_width=W)
         if len(ssms) == 1:
             # MAX_BEAM_WIDTH=1 single-draft speculation (the reference
             # default) runs fully fused on device — chains need no tree
@@ -357,6 +378,18 @@ class RequestManager:
             # for inference_debugging's per-op tensor dumps.
             return self._generate_spec_tree_fused(llm, ssms,
                                                   spec_depth=spec_depth)
+        return self._generate_spec_tree_host(llm, ssms,
+                                             spec_depth=spec_depth,
+                                             beam_width=1)
+
+    def _generate_spec_tree_host(self, llm, ssms: List[Any],
+                                 spec_depth: Optional[int] = None,
+                                 beam_width: int = 1
+                                 ) -> List[GenerationResult]:
+        """Host-stepped tree speculation: per-round draft (greedy chains or
+        ``beam_width``-wide beam search), host-side tree merge, one verify
+        step, KV commit. Slower than the fused engines (one dispatch per
+        phase) but supports beams and inference_debugging dumps."""
         llm_ifm = getattr(llm, "_inference_manager", None)
         if llm_ifm is None:
             llm_ifm = llm._inference_manager = InferenceManager(llm)
@@ -371,8 +404,8 @@ class RequestManager:
         max_seq = cfg.max_sequence_length
         depth = min(spec_depth or self.max_spec_depth, self.max_spec_depth)
         chunk = max(1, cfg.max_tokens_per_batch // max(1, min(R, 4)))
-        # tree capacity: root + depth nodes per ssm
-        T = 1 + depth * len(ssms)
+        # tree capacity: root + depth nodes per surviving branch
+        T = 1 + depth * len(ssms) * beam_width
         active: List[Optional[Request]] = [None] * R
         done: List[GenerationResult] = []
 
@@ -404,10 +437,15 @@ class RequestManager:
                 continue
             live = [req for req in active if req is not None and not req.finished]
             if live:
-                # ---- draft phase: each SSM decodes a greedy chain ----
-                chains: List[Dict[int, List[int]]] = []  # per ssm: slot->toks
+                # ---- draft phase: each SSM proposes chains (or beams) ----
+                chains: List[Dict[int, List[int]]] = []  # per branch: slot->toks
                 for i, ifm in enumerate(ssm_ifms):
-                    chains.append(self._draft_chains(ifm, i, live, R, depth))
+                    if beam_width > 1:
+                        chains.extend(self._draft_beams(
+                            ifm, i, live, R, depth, beam_width))
+                    else:
+                        chains.append(self._draft_chains(ifm, i, live, R,
+                                                         depth))
                 # clamp speculation so tree positions never pass the KV cache
                 # end / the request's length limit
                 for req in live:
@@ -776,6 +814,106 @@ class RequestManager:
             req.ssm_cache_depth[ssm_idx] = \
                 req.ssm_cache_depth.get(ssm_idx, 0) + 1
         return chains
+
+    def _draft_beams(self, ifm, ssm_idx, live, R, depth, width):
+        """Beam-search drafting on one SSM; returns ``width`` chain dicts
+        (the surviving beam paths, root excluded) ready for tree merging.
+
+        Reference machinery: BeamSearchBatchConfig + BeamTopK parent
+        tracking + per-beam KV in spec_inc_multihead_self_attention.cu.
+        TPU-first: each step stages the WHOLE current beam tree as tree
+        nodes on the draft model (tree attention gives each frontier node
+        its ancestor-path context), so no per-beam cache duplication or
+        compaction exists at all. The BEAM_SEARCH-mode graph emits packed
+        [top-k probs, top-k ids] per node (models/llama.py) and the host
+        keeps the classic cumulative-log-prob beam bookkeeping.
+
+        Correctness-first host loop: each step re-verifies the full
+        accumulated tree (~W x the frontier-only FLOPs at depth d) — beams
+        are a drafting-quality feature; the throughput paths are the fused
+        chain/tree engines. generate_spec_infer validates that ``width``
+        matches every draft's compiled max_beam_width before routing here
+        (the packed output layout is fixed at graph-build time).
+        """
+        import math
+
+        assert ifm.model.config.max_beam_width == width, \
+            (ifm.model.config.max_beam_width, width)
+        W = width
+        nodes = {}      # slot -> [token]
+        parents = {}    # slot -> [parent idx]
+        ndepth = {}     # slot -> [depth in tree]
+        scores = {}     # slot -> {node idx: cumulative logprob}
+        frontier = {}   # slot -> [node idx]
+        start = {}
+        for req in live:
+            s = req.slot
+            d = req.ssm_cache_depth.get(ssm_idx, 0)
+            assert d == len(req.tokens) - 1, (d, len(req.tokens))
+            nodes[s] = [req.tokens[-1]]
+            parents[s] = [-1]
+            ndepth[s] = [0]
+            scores[s] = {0: 0.0}
+            frontier[s] = [0]
+            start[s] = d
+        for _t in range(depth):
+            T = max(len(nodes[req.slot]) for req in live)
+            tokens = np.zeros((R, T), np.int32)
+            positions = np.zeros((R, T), np.int32)
+            parent = np.full((R, T), -1, np.int32)
+            sp = np.zeros((R,), np.int32)
+            num = np.zeros((R,), np.int32)
+            act = np.zeros((R,), bool)
+            for req in live:
+                s = req.slot
+                n = len(nodes[s])
+                tokens[s, :n] = nodes[s]
+                parent[s, :n] = parents[s]
+                positions[s, :n] = start[s] + np.asarray(ndepth[s])
+                sp[s] = start[s]
+                num[s] = n
+                act[s] = True
+            meta = TreeBatchMeta(
+                tokens=tokens, positions=positions, parent=parent,
+                ancestor=ancestor_mask_from_parents(parent), start_pos=sp,
+                num_nodes=num, active=act)
+            out = np.asarray(ifm.step(meta))        # [R, T, 2W] packed
+            probs, ids = out[..., :W], out[..., W:].astype(np.int32)
+            for req in live:
+                s = req.slot
+                cands = []
+                for fi in frontier[s]:
+                    base = scores[s][fi]
+                    for j in range(W):
+                        p = max(float(probs[s, fi, j]), 1e-20)
+                        cands.append((base + math.log(p),
+                                      int(ids[s, fi, j]), fi))
+                cands.sort(key=lambda c: -c[0])
+                new_frontier = []
+                for sc, tok, fi in cands[:W]:
+                    nodes[s].append(tok)
+                    parents[s].append(fi)
+                    ndepth[s].append(ndepth[s][fi] + 1)
+                    idx = len(nodes[s]) - 1
+                    scores[s][idx] = sc
+                    new_frontier.append(idx)
+                frontier[s] = new_frontier
+        # surviving beam paths -> chains (best beam first; merge dedups)
+        out_chains: List[Dict[int, List[int]]] = [dict() for _ in range(W)]
+        for req in live:
+            s = req.slot
+            order = sorted(frontier[s], key=lambda i: -scores[s][i])
+            for b, leaf in enumerate(order):
+                path = []
+                cur = leaf
+                while cur != 0:
+                    path.append(nodes[s][cur])
+                    cur = parents[s][cur]
+                out_chains[b][s] = list(reversed(path))
+            # the first tree step committed the pending root's KV; drafted
+            # nodes beyond are tentative (overwritten by later staging)
+            req.ssm_cache_depth[ssm_idx] = start[s] + 1
+        return out_chains
 
     def _draft_chains_debug(self, ifm, ssm_idx, live, R, depth):
         """Unfused per-token draft loop, kept for inference_debugging dumps

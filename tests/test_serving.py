@@ -335,3 +335,89 @@ def test_multi_ssm_spec_host_calls_bounded():
     # spec_rounds_per_call (default 4) rounds + a few prefill/heal steps.
     assert calls["block"] <= 14, calls
     assert calls["step"] <= 16, calls
+
+
+def test_beam_width2_spec_matches_incr_decoding():
+    """Draft beam search at width 2 (reference BeamSearchBatchConfig /
+    BeamTopK machinery): speculation output must stay token-identical to
+    incremental decoding — beams only change WHICH tree is proposed, never
+    what gets accepted."""
+    prompts = [[5, 9, 23, 44], [7, 3, 11]]
+    incr_model = make_model(seed=0)
+    rm = RequestManager()
+    for p in prompts:
+        rm.register_new_request(p, max_new_tokens=12)
+    incr = {tuple(r.input_tokens): r.output_tokens
+            for r in rm.generate_incr_decoding(incr_model)}
+
+    def make_beam_model(mode, width):
+        cfg = ff.FFConfig(max_requests_per_batch=4, max_sequence_length=64,
+                          max_tokens_per_batch=16, seed=0,
+                          kv_cache_dtype="float32", max_beam_width=width)
+        m = ff.FFModel(cfg)
+        create_llama_model(m, TINY, mode=mode)
+        m.compile(comp_mode=ff.CompMode.COMP_MODE_INFERENCE)
+        return m
+
+    llm = make_beam_model(InferenceMode.TREE_VERIFY_MODE, 1)
+    ssm = make_beam_model(InferenceMode.BEAM_SEARCH_MODE, 2)
+    # beam-mode graph ends in packed top-k, not argmax
+    assert ssm.layers[-1].op_type == ff.OpType.CONCAT
+    rm2 = RequestManager()
+    for p in prompts:
+        rm2.register_new_request(p, max_new_tokens=12)
+    spec = rm2.generate_spec_infer(llm, [ssm], spec_depth=3, beam_width=2)
+    assert len(spec) == 2
+    for r in spec:
+        assert incr[tuple(r.input_tokens)][:12] == r.output_tokens[:12]
+
+
+def test_beam_draft_proposes_wider_trees():
+    """At width 2 the draft must actually branch: the two surviving beam
+    paths differ somewhere for at least one request (random-init models
+    have near-uniform next-token distributions, so beams diverge)."""
+    def make_beam_model(mode, width, seed=1):
+        cfg = ff.FFConfig(max_requests_per_batch=4, max_sequence_length=64,
+                          max_tokens_per_batch=16, seed=seed,
+                          kv_cache_dtype="float32", max_beam_width=width)
+        m = ff.FFModel(cfg)
+        create_llama_model(m, TINY, mode=mode)
+        m.compile(comp_mode=ff.CompMode.COMP_MODE_INFERENCE)
+        return m
+
+    llm = make_beam_model(InferenceMode.TREE_VERIFY_MODE, 1)
+    ssm = make_beam_model(InferenceMode.BEAM_SEARCH_MODE, 2)
+    seen = []
+    orig = RequestManager._draft_beams
+
+    def spy(self, ifm, ssm_idx, live, R, depth, width):
+        out = orig(self, ifm, ssm_idx, live, R, depth, width)
+        seen.append([dict(c) for c in out])
+        return out
+
+    RequestManager._draft_beams = spy
+    try:
+        rm = RequestManager()
+        rm.register_new_request([5, 9, 23, 44], max_new_tokens=10)
+        rm.generate_spec_infer(llm, [ssm], spec_depth=3, beam_width=2)
+    finally:
+        RequestManager._draft_beams = orig
+    assert seen, "beam draft never ran"
+    assert any(c0 != c1 for c0, c1 in
+               (tuple(cs) for cs in seen)), "beams never diverged"
+
+
+def test_beam_width_mismatch_rejected():
+    """A draft compiled at one width cannot be driven at another: the
+    packed output layout is fixed at graph-build time."""
+    llm = make_model(mode=InferenceMode.TREE_VERIFY_MODE)
+    cfg = ff.FFConfig(max_requests_per_batch=4, max_sequence_length=64,
+                      max_tokens_per_batch=16, seed=0,
+                      kv_cache_dtype="float32", max_beam_width=2)
+    ssm = ff.FFModel(cfg)
+    create_llama_model(ssm, TINY, mode=InferenceMode.BEAM_SEARCH_MODE)
+    ssm.compile(comp_mode=ff.CompMode.COMP_MODE_INFERENCE)
+    rm = RequestManager()
+    rm.register_new_request([5, 9], max_new_tokens=4)
+    with pytest.raises(ValueError, match="max_beam_width"):
+        rm.generate_spec_infer(llm, [ssm], spec_depth=3, beam_width=1)
