@@ -238,6 +238,12 @@ def main():
     except Exception as e:  # never lose the serving headline to train issues
         mfu = {"train_mfu": f"error: {e}"}
 
+    # the Pallas fast path must have carried the serving steps (a silent
+    # jnp fallback would inflate nothing but cost O(max_seq) per step)
+    import flexflow_tpu.kernels as ffk
+
+    assert ffk.fast_path_count > 0, "Pallas serving attention never engaged"
+
     print(json.dumps({
         "metric": "specinfer_tokens_per_s",
         "config": ("llama-1.3B-class bf16" if SMALL
@@ -257,6 +263,8 @@ def main():
             f"{matches(min(128, NEW_TOKENS))}/{len(spec_res)}",
         # measured acceptance — the rate the headline was achieved at
         **meter.stats(),
+        "attention_fast_path_ops": ffk.fast_path_count,
+        "attention_fallbacks": dict(ffk.fallback_counts),
         **mfu,
     }))
 

@@ -16,8 +16,7 @@ from flexflow_tpu.keras.layers import (
     Dense,
     Flatten,
     Input,
-    MaxPooling2D,
-)
+    MaxPooling2D)
 from flexflow_tpu.keras.models import Model
 
 

@@ -10,11 +10,8 @@ _sys.path.insert(0, _os.path.abspath(_os.path.join(
 import numpy as np
 
 import flexflow_tpu.keras as keras
-from flexflow_tpu.keras.models import Model, Sequential
-from flexflow_tpu.keras.layers import (Activation, Add, Concatenate, Conv2D,
-                                       Dense, Dropout, Flatten, Input,
-                                       Maximum, Minimum, MaxPooling2D,
-                                       Multiply, Permute, Reshape)
+from flexflow_tpu.keras.models import Sequential
+from flexflow_tpu.keras.layers import Dense
 
 from flexflow_tpu.keras import regularizers
 from flexflow_tpu.keras.datasets import mnist

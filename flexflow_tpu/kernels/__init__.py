@@ -21,6 +21,41 @@ def pallas_interpret_forced() -> bool:
     return os.environ.get("FF_PALLAS_INTERPRET", "") not in ("", "0")
 
 
+# ----------------------------------------------------------------------
+# Fast-path observability (r1 VERDICT: a silent jnp fallback "pays for
+# max_seq" with no signal). Counters are per-process; the first fallback
+# of each distinct reason logs a warning once.
+# ----------------------------------------------------------------------
+fallback_counts: dict = {}
+fast_path_count: int = 0
+_warned: set = set()
+
+
+def record_fast_path():
+    global fast_path_count
+    fast_path_count += 1
+
+
+def record_fallback(reason: str):
+    """Count (and warn once per reason) a serving-attention jnp fallback."""
+    fallback_counts[reason] = fallback_counts.get(reason, 0) + 1
+    if reason not in _warned:
+        _warned.add(reason)
+        import warnings
+
+        warnings.warn(
+            f"serving attention fell back to the jnp path ({reason}); "
+            "this pays O(max_seq) per step instead of streaming the "
+            "valid cache prefix", stacklevel=3)
+
+
+def reset_dispatch_stats():
+    global fast_path_count
+    fallback_counts.clear()
+    _warned.clear()
+    fast_path_count = 0
+
+
 def use_pallas(config=None) -> bool:
     """Should serving ops run their Pallas kernels?"""
     if config is not None and not getattr(config, "use_pallas", True):

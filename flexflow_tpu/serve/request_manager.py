@@ -857,7 +857,13 @@ class RequestManager:
             frontier[s] = [0]
             start[s] = d
         for _t in range(depth):
-            T = max(len(nodes[req.slot]) for req in live)
+            # pad the staged width to a sublane multiple so the biased
+            # (tree) flash path stays engaged on TPU (pad nodes are masked
+            # off via num_nodes; see MultiSpecEngine.tree_width). Staging
+            # near max_seq is safe: append_kv drops out-of-range writes
+            # and flash_attend clamps lengths to the cache end — garbage
+            # proposals there simply fail verification.
+            T = -(-max(len(nodes[req.slot]) for req in live) // 8) * 8
             tokens = np.zeros((R, T), np.int32)
             positions = np.zeros((R, T), np.int32)
             parent = np.full((R, T), -1, np.int32)
@@ -946,6 +952,7 @@ class RequestManager:
         return chains
 
     def _verify_and_commit(self, llm, ifm, live, trees, R, T, max_seq, depth):
+        T = -(-T // 8) * 8   # sublane-align the verify width (flash path)
         tokens = np.zeros((R, T), np.int32)
         positions = np.zeros((R, T), np.int32)
         parent = np.full((R, T), -1, np.int32)
