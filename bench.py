@@ -203,6 +203,14 @@ def main():
                  warm, 4)
     jax.block_until_ready(llm.op_state["kv_cache"]["k"])
 
+    # the Pallas fast path must have carried the warmup traces (a silent
+    # jnp fallback would cost O(max_seq) per step); checked BEFORE the
+    # timed passes so a failure doesn't throw away minutes of measurement
+    import flexflow_tpu.kernels as ffk
+
+    assert ffk.fast_path_count > 0, "Pallas serving attention never engaged"
+    assert not ffk.fallback_counts, ffk.fallback_counts
+
     # two timed passes each, best kept: the remote-tunnel dispatch latency
     # jitters ~10% run-to-run and the computation is deterministic
     incr_tps, incr_res = max(
@@ -238,12 +246,6 @@ def main():
     except Exception as e:  # never lose the serving headline to train issues
         mfu = {"train_mfu": f"error: {e}"}
 
-    # the Pallas fast path must have carried the serving steps (a silent
-    # jnp fallback would inflate nothing but cost O(max_seq) per step)
-    import flexflow_tpu.kernels as ffk
-
-    assert ffk.fast_path_count > 0, "Pallas serving attention never engaged"
-
     print(json.dumps({
         "metric": "specinfer_tokens_per_s",
         "config": ("llama-1.3B-class bf16" if SMALL
@@ -263,8 +265,10 @@ def main():
             f"{matches(min(128, NEW_TOKENS))}/{len(spec_res)}",
         # measured acceptance — the rate the headline was achieved at
         **meter.stats(),
-        "attention_fast_path_ops": ffk.fast_path_count,
-        "attention_fallbacks": dict(ffk.fallback_counts),
+        # trace-time dispatch counts: how many attention ops COMPILED onto
+        # each path (fused loops trace once however many steps execute)
+        "attention_fast_path_traces": ffk.fast_path_count,
+        "attention_fallback_traces": dict(ffk.fallback_counts),
         **mfu,
     }))
 

@@ -301,6 +301,7 @@ class FFModel:
             rope_theta=rope_theta,
             max_requests=self.config.max_requests_per_batch,
             max_seq_length=self.config.max_sequence_length,
+            use_pallas=self.config.use_pallas,
             cache_dtype=self.config.kv_cache_dtype), name)
 
     def inc_multihead_self_attention(self, input: Tensor, embed_dim: int,

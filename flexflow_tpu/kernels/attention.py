@@ -36,6 +36,16 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30  # finite "minus infinity": keeps online softmax NaN-free
 
+# Mosaic tiling: DMA slices need the sublane (second-minor) dim 8-aligned
+# and the lane (minor) dim 128-aligned — the single source of truth for
+# the dispatch guards here and the width/head-dim padding at call sites.
+SUBLANE = 8
+LANE = 128
+
+
+def round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
 
 def _pick_block_s(S: int) -> int:
     """Cache-stream block size: the smallest supported tile. Decode is

@@ -230,13 +230,19 @@ def _weight_specs(attrs, input_specs):
     return specs
 
 
-def padded_head_dim(D: int) -> int:
+def padded_head_dim(D: int, want_pallas: bool = True) -> int:
     """Caches allocate head_dim rounded up to the 128-lane tile: Mosaic
     DMAs slice the trailing dim, so D=64-class models (GPT-2, StarCoder)
     would otherwise fall off the flash path entirely (r1 VERDICT). The
     pad costs KV memory/bandwidth (2x at D=64) but keeps the streamed
-    ceil(len/BS) read pattern, which beats the jnp fallback's O(max_seq)."""
-    return -(-D // 128) * 128
+    ceil(len/BS) read pattern, which beats the jnp fallback's O(max_seq).
+    Configs that can never take the flash path (use_pallas off, non-TPU
+    backend) keep the exact D — padding would only cost them memory."""
+    if not want_pallas:
+        return D
+    from flexflow_tpu.kernels.attention import LANE, round_up
+
+    return round_up(D, LANE)
 
 
 def _pad_d(x, D_pad: int):
@@ -249,11 +255,14 @@ def _pad_d(x, D_pad: int):
 def _init_kv_state(attrs, input_specs):
     import numpy as np
 
+    from flexflow_tpu import kernels as ffk
+
     R = attrs["max_requests"]
     S = attrs["max_seq_length"]
     KH, D = attrs["num_kv_heads"], attrs["head_dim"]
     cache_dtype = jnp.dtype(attrs.get("cache_dtype", "bfloat16"))
-    Dp = padded_head_dim(D)
+    Dp = padded_head_dim(
+        D, want_pallas=(attrs.get("use_pallas", True) and ffk.use_pallas()))
     return {
         "k_cache": jnp.zeros((R, KH, S, Dp), dtype=cache_dtype),
         "v_cache": jnp.zeros((R, KH, S, Dp), dtype=cache_dtype),
@@ -320,15 +329,15 @@ def append_and_ref(ctx, attrs, k, v, start_pos, num_tokens, active):
     per-layer slice path."""
     ov = getattr(ctx, "kv_override", None)
     idx = attrs.get("cache_layer_idx")
-    Dp = padded_head_dim(k.shape[-1])
-    k, v = _pad_d(k, Dp), _pad_d(v, Dp)
     if ov is not None or idx is None or k.shape[1] != 1:
         k0, v0 = read_kv(ctx, attrs)
+        k, v = _pad_d(k, k0.shape[-1]), _pad_d(v, v0.shape[-1])
         kc = append_kv(k0, k, start_pos, num_tokens, active)
         vc = append_kv(v0, v, start_pos, num_tokens, active)
         write_kv(ctx, attrs, kc, vc)
         return kc, vc, None
     st = ctx.state_out.get("kv_cache") or ctx.state_in["kv_cache"]
+    k, v = _pad_d(k, st["k"].shape[-1]), _pad_d(v, st["v"].shape[-1])
     ks = append_kv_stacked(st["k"], idx, k, start_pos, num_tokens, active)
     vs = append_kv_stacked(st["v"], idx, v, start_pos, num_tokens, active)
     ctx.state_out["kv_cache"] = {"k": ks, "v": vs}

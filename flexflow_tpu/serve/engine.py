@@ -179,8 +179,10 @@ class MultiSpecEngine:
         """Verify width: real nodes padded to a sublane multiple (Mosaic
         DMAs slice the [Q, BS] bias block, so Q must be 8-aligned; padding
         nodes are masked off via num_nodes and their outputs unread)."""
+        from flexflow_tpu.kernels.attention import SUBLANE, round_up
+
         T = 1 + len(self.ssms) * self.depth
-        return -(-T // 8) * 8
+        return round_up(T, SUBLANE)
 
     def _tree_constants(self, R):
         d, B = self.depth, len(self.ssms)

@@ -863,7 +863,9 @@ class RequestManager:
             # near max_seq is safe: append_kv drops out-of-range writes
             # and flash_attend clamps lengths to the cache end — garbage
             # proposals there simply fail verification.
-            T = -(-max(len(nodes[req.slot]) for req in live) // 8) * 8
+            from flexflow_tpu.kernels.attention import SUBLANE, round_up
+
+            T = round_up(max(len(nodes[req.slot]) for req in live), SUBLANE)
             tokens = np.zeros((R, T), np.int32)
             positions = np.zeros((R, T), np.int32)
             parent = np.full((R, T), -1, np.int32)
@@ -952,7 +954,9 @@ class RequestManager:
         return chains
 
     def _verify_and_commit(self, llm, ifm, live, trees, R, T, max_seq, depth):
-        T = -(-T // 8) * 8   # sublane-align the verify width (flash path)
+        from flexflow_tpu.kernels.attention import SUBLANE, round_up
+
+        T = round_up(T, SUBLANE)  # sublane-align the verify width (flash)
         tokens = np.zeros((R, T), np.int32)
         positions = np.zeros((R, T), np.int32)
         parent = np.full((R, T), -1, np.int32)
