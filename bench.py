@@ -179,7 +179,7 @@ def main():
     # Pre-compile the block + prefill programs via short warm runs. Cache
     # garbage from these dummy calls is harmless: every request re-prefills
     # from position 0.
-    from flexflow_tpu.serve.engine import (MultiSpecEngine, SpecChainEngine)
+    from flexflow_tpu.serve.engine import MultiSpecEngine, SpecChainEngine
     from flexflow_tpu.serve.inference_manager import InferenceManager
 
     llm._inference_manager = ifm = InferenceManager(llm)

@@ -314,6 +314,43 @@ def write_kv(ctx, attrs, k_cache, v_cache):
                                  "v": st["v"].at[idx].set(v_cache)}
 
 
+def append_kv_contiguous(cache, layer_idx, new, start_pos, active):
+    """In-place contiguous append: per-request dynamic_update_slice of the
+    [KH, Q, D] run at start_pos[r] — no scatter at all.
+
+    Callable ONLY under the engines' guarantee that every ACTIVE row has
+    start_pos + Q <= S (their live_masks enforce it). Inactive rows
+    re-write their current region unchanged (a slot can be live but
+    sitting out of an engine block — e.g. cramped near the cache end —
+    so its KV must not be touched). Padding tokens beyond num_tokens
+    write garbage BEYOND the valid extent, masked by lengths until
+    overwritten by the next real append.
+
+    This beats both scatter forms: the windowed scatter forces a permuted
+    layout + full per-layer cache copies (~134MB/layer/step at 7B), and
+    the row-granular scatter is scalar-unit bound (~0.1ms per 1280-row
+    scatter at 7B MHA).
+    """
+    R, Q = new.shape[0], new.shape[1]
+    S = cache.shape[-2]
+    KH, D = cache.shape[-3], cache.shape[-1]
+    newT = jnp.swapaxes(new.astype(cache.dtype), 1, 2)    # [R, KH, Q, D]
+
+    def body(r, c):
+        s = jnp.clip(start_pos[r], 0, S - Q)
+        if layer_idx is None:
+            cur = jax.lax.dynamic_slice(c, (r, 0, s, 0), (1, KH, Q, D))
+            upd = jnp.where(active[r], newT[r][None], cur)
+            return jax.lax.dynamic_update_slice(c, upd, (r, 0, s, 0))
+        cur = jax.lax.dynamic_slice(c, (layer_idx, r, 0, s, 0),
+                                    (1, 1, KH, Q, D))
+        upd = jnp.where(active[r], newT[r][None, None], cur)
+        return jax.lax.dynamic_update_slice(c, upd,
+                                            (layer_idx, r, 0, s, 0))
+
+    return jax.lax.fori_loop(0, R, body, cache)
+
+
 def append_and_ref(ctx, attrs, k, v, start_pos, num_tokens, active):
     """Append this step's KV and return (k_ref, v_ref, layer_idx) to attend
     over: layer_idx is None when the refs are this layer's own [R,KH,S,D]
@@ -321,25 +358,49 @@ def append_and_ref(ctx, attrs, k, v, start_pos, num_tokens, active):
     (stacked caches append in place — see append_kv_stacked). New k/v pad
     to the cache's (128-lane-tiled) head dim first.
 
-    Only decode (Q == 1) takes the row-granular stacked path: its scatter
-    is ~R*KH index rows and beats the slice-out/write-back round trip by
-    ~0.45ms/step at bench geometry. Wider steps (prefill chunks, tree
-    verify) invert — R*KH*Q row-scatters cost more scalar-unit time than
-    the windowed scatter + one cache copy they'd save — and keep the
-    per-layer slice path."""
+    The row-granular stacked path is chosen whenever its scalar-unit cost
+    (~R*KH*Q index rows) beats the per-layer slice-out/write-back HBM
+    round trip of the windowed path: always for decode (Q == 1), and for
+    wider steps (prefill chunks, tree verify) once the per-layer cache
+    slice is large — at 7B geometry the slice traffic is ~134MB per layer
+    per step and dominated the whole speculation round."""
     ov = getattr(ctx, "kv_override", None)
     idx = attrs.get("cache_layer_idx")
-    if ov is not None or idx is None or k.shape[1] != 1:
+    contiguous = getattr(ctx, "kv_contiguous", False)
+    if ov is not None or idx is None:
         k0, v0 = read_kv(ctx, attrs)
         k, v = _pad_d(k, k0.shape[-1]), _pad_d(v, v0.shape[-1])
-        kc = append_kv(k0, k, start_pos, num_tokens, active)
-        vc = append_kv(v0, v, start_pos, num_tokens, active)
+        if contiguous and k.shape[1] != 1:
+            kc = append_kv_contiguous(k0, None, k, start_pos, active)
+            vc = append_kv_contiguous(v0, None, v, start_pos, active)
+        else:
+            kc = append_kv(k0, k, start_pos, num_tokens, active)
+            vc = append_kv(v0, v, start_pos, num_tokens, active)
         write_kv(ctx, attrs, kc, vc)
         return kc, vc, None
     st = ctx.state_out.get("kv_cache") or ctx.state_in["kv_cache"]
     k, v = _pad_d(k, st["k"].shape[-1]), _pad_d(v, st["v"].shape[-1])
-    ks = append_kv_stacked(st["k"], idx, k, start_pos, num_tokens, active)
-    vs = append_kv_stacked(st["v"], idx, v, start_pos, num_tokens, active)
+    if contiguous and k.shape[1] != 1:
+        # wide contiguous appends (engine verify/catch-up): scatter-free
+        # DUS; decode (Q == 1) stays on the per-(r,kh) row scatter — at 7B
+        # the stacked 5D DUS read-modify loop defeats XLA's in-place
+        # aliasing and copies the stack, while the 64-256-row scatter is
+        # cheap
+        ks = append_kv_contiguous(st["k"], idx, k, start_pos, active)
+        vs = append_kv_contiguous(st["v"], idx, v, start_pos, active)
+    elif k.shape[1] == 1:
+        ks = append_kv_stacked(st["k"], idx, k, start_pos, num_tokens,
+                               active)
+        vs = append_kv_stacked(st["v"], idx, v, start_pos, num_tokens,
+                               active)
+    else:
+        # host-stepped wide appends (prefill chunks, host tree verify):
+        # drop-exact windowed scatter on the per-layer slice — paid once
+        # per prefill, not per speculation round
+        kc = append_kv(st["k"][idx], k, start_pos, num_tokens, active)
+        vc = append_kv(st["v"][idx], v, start_pos, num_tokens, active)
+        ks = st["k"].at[idx].set(kc)
+        vs = st["v"].at[idx].set(vc)
     ctx.state_out["kv_cache"] = {"k": ks, "v": vs}
     return ks, vs, idx
 

@@ -48,11 +48,18 @@ def build_feeds(model, meta):
     return feeds
 
 
-def forward_with_meta(model, params, state, meta, rng, compute_dtype):
+def forward_with_meta(model, params, state, meta, rng, compute_dtype,
+                      kv_contiguous=False):
     """One serving forward over a BatchMeta inside jit — the single traced
-    body shared by InferenceManager.step and the fused engines."""
+    body shared by InferenceManager.step and the fused engines.
+
+    ``kv_contiguous=True`` (fused engines only) promises every active
+    row's append region [start, start+Q) is in bounds, unlocking the
+    scatter-free dynamic_update_slice KV append (inc_attention.py
+    append_kv_contiguous)."""
     ctx = OpContext(training=False, rng=rng, compute_dtype=compute_dtype,
                     batch_config=meta, mesh=model.mesh, config=model.config)
+    ctx.kv_contiguous = kv_contiguous
     values, new_state = model._run_graph(params, build_feeds(model, meta),
                                          ctx, state)
     return values[model._final_tensor.tensor_id], new_state
@@ -60,10 +67,15 @@ def forward_with_meta(model, params, state, meta, rng, compute_dtype):
 
 def _forward_tokens(model, params, state, tokens, positions, start_pos,
                     num_tokens, active, rng, compute_dtype):
-    """One forward over [R, Q] tokens inside jit; returns (out, new_state)."""
+    """One forward over [R, Q] tokens inside jit; returns (out, new_state).
+
+    All engine-issued forwards stage contiguous, bounds-guaranteed KV
+    runs (each engine's live_mask reserves the full staging window), so
+    the scatter-free append path applies."""
     meta = BatchMeta(tokens=tokens, positions=positions, start_pos=start_pos,
                      num_tokens=num_tokens, active=active)
-    return forward_with_meta(model, params, state, meta, rng, compute_dtype)
+    return forward_with_meta(model, params, state, meta, rng, compute_dtype,
+                             kv_contiguous=True)
 
 
 def make_draft_chain(model, compute_dtype, depth: int):
@@ -298,7 +310,8 @@ class MultiSpecEngine:
             active=active)
         out, llm_state = forward_with_meta(
             self.llm, llm_params, llm_state, meta,
-            jax.random.fold_in(rng, 7), self._compute_dtype)
+            jax.random.fold_in(rng, 7), self._compute_dtype,
+            kv_contiguous=True)
         o = out.astype(jnp.int32)                   # [R, T]
 
         # --- per-branch greedy acceptance, best branch wins ---
@@ -320,7 +333,11 @@ class MultiSpecEngine:
         best_chain = jnp.take_along_axis(
             jnp.stack(chains, axis=1), best_j[:, None, None], axis=1)[:, 0]
 
-        llm_state = self._commit(llm_state, best_j, n_acc, r_pos, active)
+        if B > 1:
+            # single-branch trees are already contiguous (branch 0's slots
+            # ARE the committed region) — no compaction needed
+            llm_state = self._commit(llm_state, best_j, n_acc, r_pos,
+                                     active)
 
         # next round's accepted block: [accepted chain prefix, bonus]
         blk = jnp.zeros((R, d + 1), jnp.int32)
@@ -349,9 +366,13 @@ class MultiSpecEngine:
         nblk0 = jnp.ones((R,), jnp.int32)
         base0 = pos
 
+        Tp = self.tree_width
+
         def live_mask(base, nblk, remaining):
             r_pos = base + nblk - 1
-            return ((remaining > 0) & (r_pos + B * d < max_seq))
+            # reserve the PADDED verify width: the contiguous KV append
+            # writes the whole [r_pos, r_pos + Tp) staging window
+            return ((remaining > 0) & (r_pos + Tp <= max_seq - 1))
 
         def cond(carry):
             i, _ls, _ss, _tks, nblk, base, remaining, act, _p = carry

@@ -662,8 +662,12 @@ class RequestManager:
         chunk = max(1, cfg.max_tokens_per_batch // max(1, min(R, 4)))
         active: List[Optional[Request]] = [None] * R
         done: List[GenerationResult] = []
-        # a request can draft only with a full tree of KV room left
-        room_needed = B * depth + 1
+        # a request can draft only with the engine's FULL staging window of
+        # KV room left — derived from the engine itself (its live_mask
+        # reserves the sublane-PADDED verify width; a looser host gate here
+        # would keep scheduling a request the engine masks dead every
+        # round, hanging the loop)
+        room_needed = engine.tree_width
 
         while self.pending or any(a is not None for a in active):
             self._fill_slots(active, max_seq, done)

@@ -421,3 +421,26 @@ def test_beam_width_mismatch_rejected():
     rm.register_new_request([5, 9], max_new_tokens=4)
     with pytest.raises(ValueError, match="max_beam_width"):
         rm.generate_spec_infer(llm, [ssm], spec_depth=3, beam_width=1)
+
+
+def test_spec_infer_multi_ssm_draftable_window_terminates():
+    """Regression: the host draftable gate must be at least as strict as
+    MultiSpecEngine's live_mask (which reserves the sublane-PADDED verify
+    width). A prompt landing in the gap between the unpadded and padded
+    windows previously made the engine mask the request dead every round
+    while the host kept rescheduling it — an infinite loop."""
+    prompt = list(range(1, 19))      # len 18, max_seq 32: in the gap for
+    depth = 4                        # B=2, d=4 (T=9 pads to 16)
+    incr_model = make_model(seed=0, max_seq=32)
+    rm = RequestManager()
+    rm.register_new_request(prompt, max_new_tokens=10)
+    incr = rm.generate_incr_decoding(incr_model)[0].output_tokens
+
+    llm = make_model(mode=InferenceMode.TREE_VERIFY_MODE, seed=0, max_seq=32)
+    ssm1 = make_model(mode=InferenceMode.BEAM_SEARCH_MODE, seed=0, max_seq=32)
+    ssm2 = make_model(mode=InferenceMode.BEAM_SEARCH_MODE, seed=7, max_seq=32)
+    rm2 = RequestManager()
+    rm2.register_new_request(prompt, max_new_tokens=10)
+    spec = rm2.generate_spec_infer(llm, [ssm1, ssm2], spec_depth=depth)
+    assert spec[0].output_tokens == incr[:len(spec[0].output_tokens)]
+    assert len(spec[0].output_tokens) == 10
