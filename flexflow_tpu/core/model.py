@@ -154,14 +154,18 @@ class FFModel:
               activation: ActiMode = ActiMode.AC_MODE_NONE,
               use_bias: bool = True, datatype: Optional[DataType] = None,
               kernel_initializer=None, bias_initializer=None,
-              kernel_regularizer=None,
+              kernel_regularizer=None, keep_f32_logits: bool = False,
               name: Optional[str] = None) -> Tensor:
         """kernel_regularizer: ("l1"|"l2", coeff) or a list of such pairs —
-        added to the training loss (reference keras regularizers)."""
+        added to the training loss (reference keras regularizers).
+        keep_f32_logits: for LM heads feeding argmax/sampling — emit the
+        gemm's f32 accumulator instead of rounding to the compute dtype
+        (bf16 ties flip greedy argmax between serving programs)."""
         return self._add_layer(OpType.LINEAR, [input], dict(
             out_dim=out_dim, activation=activation, use_bias=use_bias,
             data_type=datatype, kernel_initializer=kernel_initializer,
             bias_initializer=bias_initializer,
+            keep_f32_logits=keep_f32_logits,
             kernel_regularizer=_normalize_regularizer(kernel_regularizer)),
             name)
 

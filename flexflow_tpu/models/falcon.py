@@ -114,6 +114,7 @@ def create_falcon_model(model, config: FalconConfig,
 
     h = ln(h, "ln_f")
     logits = model.dense(h, c.vocab_size, use_bias=False, datatype=data_type,
+                         keep_f32_logits=True,
                          name="lm_head")
     gen = generation_config or GenerationConfig()
     if gen.do_sample and mode == InferenceMode.INC_DECODING_MODE:

@@ -92,7 +92,8 @@ def create_llama_model(model, config: LLAMAConfig,
 
     x = model.rms_norm(h, eps=c.rms_norm_eps, dim=c.hidden_size, name="norm")
     logits = model.dense(x, c.vocab_size, use_bias=False,
-                         datatype=data_type, name="lm_head")
+                         datatype=data_type, keep_f32_logits=True,
+                         name="lm_head")
     gen = generation_config or GenerationConfig()
     if gen.do_sample and mode == InferenceMode.INC_DECODING_MODE:
         out = model.sampling(logits, top_p=gen.topp, temperature=gen.temperature)

@@ -89,6 +89,7 @@ def create_mpt_model(model, config: MPTConfig,
     h = model.layer_norm(h, axes=[-1], eps=c.layer_norm_epsilon,
                          use_bias=use_bias, name="norm_f")
     logits = model.dense(h, c.vocab_size, use_bias=False, datatype=data_type,
+                         keep_f32_logits=True,
                          name="lm_head")
     gen = generation_config or GenerationConfig()
     if gen.do_sample and mode == InferenceMode.INC_DECODING_MODE:

@@ -130,6 +130,7 @@ def create_opt_model(model, config: OPTConfig,
         h = model.dense(h, c.word_embed_proj_dim, use_bias=False,
                         datatype=data_type, name="project_out")
     logits = model.dense(h, c.vocab_size, use_bias=False, datatype=data_type,
+                         keep_f32_logits=True,
                          name="lm_head")
     gen = generation_config or GenerationConfig()
     if gen.do_sample and mode == InferenceMode.INC_DECODING_MODE:

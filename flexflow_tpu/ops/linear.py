@@ -77,8 +77,18 @@ class Linear(OpImpl):
         x = inputs[0]
         kernel = params["kernel"]
         compute_dtype = ctx.compute_dtype or x.dtype
+        out_dtype = None
+        if attrs.get("keep_f32_logits"):
+            # logits heads keep the gemm's f32 ACCUMULATOR instead of
+            # rounding to bf16: exact bf16 ties between near-equal logits
+            # made greedy argmax flip between the width-1 decode and
+            # width-k verify programs (XLA tiles them differently) on
+            # close distributions. Only the result dtype changes — the
+            # gemm operands stay bf16, so the MXU cost is unchanged and
+            # the cast skipped was the last op before argmax/sampling.
+            out_dtype = jnp.float32
         if is_quantized(kernel) or compute_dtype != jnp.float64:
-            y = qmatmul(x, kernel, compute_dtype)
+            y = qmatmul(x, kernel, compute_dtype, out_dtype=out_dtype)
         else:
             y = jax.lax.dot_general(
                 x.astype(compute_dtype), kernel.astype(compute_dtype),

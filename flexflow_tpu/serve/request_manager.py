@@ -365,10 +365,17 @@ class RequestManager:
             return self._generate_spec_tree_host(llm, ssms,
                                                  spec_depth=spec_depth,
                                                  beam_width=W)
-        if len(ssms) == 1:
+        from flexflow_tpu import kernels as ffk
+
+        if len(ssms) == 1 and not ffk.use_pallas(llm.config):
             # MAX_BEAM_WIDTH=1 single-draft speculation (the reference
-            # default) runs fully fused on device — chains need no tree
-            # merge and no KV compaction.
+            # default) fully fused as a chain: no tree merge, no KV
+            # compaction, narrowest verify. Preferred off-TPU, where the
+            # B=1 tree engine's wider (sublane-padded) verify and
+            # catch-up machinery cost more per-op overhead than the
+            # chain's extra KV-backfill draft step saves. On TPU the
+            # weight-bound rounds invert that tradeoff and the fused
+            # tree engine below wins (~12% per round at 7B geometry).
             return self._generate_spec_chain(llm, ssms[0],
                                              spec_depth=spec_depth)
         if not llm.config.inference_debugging:

@@ -444,3 +444,27 @@ def test_spec_infer_multi_ssm_draftable_window_terminates():
     spec = rm2.generate_spec_infer(llm, [ssm1, ssm2], spec_depth=depth)
     assert spec[0].output_tokens == incr[:len(spec[0].output_tokens)]
     assert len(spec[0].output_tokens) == 10
+
+
+def test_single_ssm_fused_tree_path_matches_chain():
+    """On TPU a single SSM routes through the B=1 fused tree engine
+    (backend-dependent dispatch in generate_spec_infer); its output must
+    be identical to the chain engine's — same greedy acceptance, same
+    verifier — exercised here by calling the tree path directly."""
+    prompts = [[5, 9, 23, 44], [7, 3, 11]]
+    incr_model = make_model(seed=0)
+    rm = RequestManager()
+    for p in prompts:
+        rm.register_new_request(p, max_new_tokens=12)
+    incr = {tuple(r.input_tokens): r.output_tokens
+            for r in rm.generate_incr_decoding(incr_model)}
+
+    llm = make_model(mode=InferenceMode.TREE_VERIFY_MODE, seed=0)
+    ssm = make_model(mode=InferenceMode.BEAM_SEARCH_MODE, seed=0)
+    rm2 = RequestManager()
+    for p in prompts:
+        rm2.register_new_request(p, max_new_tokens=12)
+    spec = rm2._generate_spec_tree_fused(llm, [ssm], spec_depth=4)
+    assert len(spec) == 2
+    for r in spec:
+        assert incr[tuple(r.input_tokens)][:12] == r.output_tokens[:12]
