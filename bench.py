@@ -195,9 +195,9 @@ def main():
     act0 = np.ones((NUM_REQUESTS,), bool)
     # warm whichever engine generate_spec_infer will dispatch to (the
     # fused tree engine on TPU / multi-SSM; the chain engine off-TPU)
-    import flexflow_tpu.kernels as _ffk
+    import flexflow_tpu.kernels as ffk
 
-    if MULTI or _ffk.use_pallas(llm.config):
+    if MULTI or ffk.use_pallas(llm.config):
         llm._multi_engine = eng = MultiSpecEngine(llm, ssms, SPEC_DEPTH,
                                                   max_rounds=SPEC_ROUNDS)
     else:
@@ -215,8 +215,6 @@ def main():
     # the Pallas fast path must have carried the warmup traces (a silent
     # jnp fallback would cost O(max_seq) per step); checked BEFORE the
     # timed passes so a failure doesn't throw away minutes of measurement
-    import flexflow_tpu.kernels as ffk
-
     assert ffk.fast_path_count > 0, "Pallas serving attention never engaged"
     assert not ffk.fallback_counts, ffk.fallback_counts
 

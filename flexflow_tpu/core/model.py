@@ -155,15 +155,25 @@ class FFModel:
               use_bias: bool = True, datatype: Optional[DataType] = None,
               kernel_initializer=None, bias_initializer=None,
               kernel_regularizer=None, keep_f32_logits: bool = False,
+              data_type: Optional[DataType] = None,
               name: Optional[str] = None) -> Tensor:
         """kernel_regularizer: ("l1"|"l2", coeff) or a list of such pairs —
         added to the training loss (reference keras regularizers).
         keep_f32_logits: for LM heads feeding argmax/sampling — emit the
         gemm's f32 accumulator instead of rounding to the compute dtype
-        (bf16 ties flip greedy argmax between serving programs)."""
+        (bf16 ties flip greedy argmax between serving programs).
+        ``data_type`` and ``datatype`` are synonyms: the reference's cffi
+        dense() spells it ``datatype`` while every other builder here uses
+        ``data_type`` — both call styles must work (r1 VERDICT)."""
+        if (datatype is not None and data_type is not None
+                and datatype != data_type):
+            raise ValueError(
+                f"dense(): conflicting datatype={datatype} and "
+                f"data_type={data_type} (they are synonyms)")
         return self._add_layer(OpType.LINEAR, [input], dict(
             out_dim=out_dim, activation=activation, use_bias=use_bias,
-            data_type=datatype, kernel_initializer=kernel_initializer,
+            data_type=datatype if datatype is not None else data_type,
+            kernel_initializer=kernel_initializer,
             bias_initializer=bias_initializer,
             keep_f32_logits=keep_f32_logits,
             kernel_regularizer=_normalize_regularizer(kernel_regularizer)),

@@ -48,6 +48,8 @@ class Linear(OpImpl):
         (shape, dtype) = input_specs[0]
         out_dim = attrs["out_dim"]
         out_dtype = attrs.get("data_type") or dtype
+        if attrs.get("keep_f32_logits"):
+            out_dtype = DataType.DT_FLOAT   # forward emits f32 logits
         return [(tuple(shape[:-1]) + (out_dim,), out_dtype)]
 
     @staticmethod

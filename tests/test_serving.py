@@ -468,3 +468,33 @@ def test_single_ssm_fused_tree_path_matches_chain():
     assert len(spec) == 2
     for r in spec:
         assert incr[tuple(r.input_tokens)][:12] == r.output_tokens[:12]
+
+
+def test_long_context_serving():
+    """Long-context serving: a 1,500-token prompt in a 2,048-slot KV cache
+    must prefill in chunks and decode correctly (long context is
+    first-class — the cache/streaming design must not assume short S)."""
+    cfg = ff.FFConfig(max_requests_per_batch=2, max_sequence_length=2048,
+                      max_tokens_per_batch=256, seed=0,
+                      kv_cache_dtype="float32")
+    m = ff.FFModel(cfg)
+    create_llama_model(m, TINY, mode=InferenceMode.INC_DECODING_MODE)
+    m.compile(comp_mode=ff.CompMode.COMP_MODE_INFERENCE)
+    rng = np.random.RandomState(0)
+    long_prompt = [int(t) for t in rng.randint(1, 100, size=1500)]
+    short_prompt = [5, 9, 23]
+    rm = RequestManager()
+    rm.register_new_request(long_prompt, max_new_tokens=6)
+    rm.register_new_request(short_prompt, max_new_tokens=6)
+    res = {tuple(r.input_tokens): r.output_tokens
+           for r in rm.generate_incr_decoding(m)}
+    assert len(res[tuple(long_prompt)]) == 6
+    # the short request must be unaffected by sharing a batch with the
+    # long one: compare against a solo run
+    rm2 = RequestManager()
+    rm2.register_new_request(short_prompt, max_new_tokens=6)
+    m2 = ff.FFModel(cfg)
+    create_llama_model(m2, TINY, mode=InferenceMode.INC_DECODING_MODE)
+    m2.compile(comp_mode=ff.CompMode.COMP_MODE_INFERENCE)
+    solo = rm2.generate_incr_decoding(m2)[0].output_tokens
+    assert res[tuple(short_prompt)] == solo
