@@ -20,16 +20,18 @@ TPU-first redesign — no task placement, no per-stage processes:
 * The block segment runs inside ``jax.shard_map`` that is **manual over
   "pipe" only** — tensor-parallel sharding of the per-layer weights stays on
   GSPMD ("model" axis is auto), so TP x PP compose inside one jitted step.
-* Per step the activation ring-shifts stage -> stage+1 with ``ppermute`` for
-  P rounds; stage s commits its KV-cache updates only on round s (the round
-  its input is the real activation). Embedding/lm-head (pre/post segments)
-  stay on the plain GSPMD path.
+* Per step the request slots split into M microbatches streaming through
+  the stages on the classic GPipe M+P-1-tick schedule (``_pp_segment``);
+  each tick a stage applies its layer blocks to ONE microbatch, hands the
+  activation to the next stage with ``ppermute``, and commits KV only for
+  that microbatch's row slice. Embedding/lm-head (pre/post segments) stay
+  on the plain GSPMD path.
 
-The P-round schedule is the single-batch bubble the reference also pays per
-batch; its depth-4 in-flight batch pipeline amortizes it across batches,
-ours amortizes host round-trips with the fused decode block
-(serve/engine.py) — each decode-block step pays P rounds of ICI hops but
-zero host involvement.
+The (P-1)-tick bubble is the same one the reference pays per batch; its
+depth-4 in-flight batch pipeline amortizes it across batches, ours
+amortizes it across the microbatches of one batch — and host round-trips
+amortize separately via the fused decode block (serve/engine.py): each
+decode-block step pays M+P-1 ticks of ICI hops but zero host involvement.
 """
 
 from __future__ import annotations
@@ -298,7 +300,16 @@ def _apply_block(model, plan, ctx, lp_by_pos, k_l, v_l, x):
 
 
 def _pp_segment(model, plan):
-    """Build (and cache) the shard_map'd block-segment function."""
+    """Build (and cache) the shard_map'd block-segment function.
+
+    GPipe microbatch schedule over REQUEST SLOTS: the batch's R rows split
+    into M microbatches (M = largest divisor of R <= P) that stream
+    through the P stages in M+P-1 ticks — per step, each stage computes
+    (M+P-1)/M microbatch-forwards instead of P full-batch forwards
+    (utilization M*P/(M+P-1) vs 1/P for the naive round-robin), and KV
+    commits touch only the active microbatch's row slice instead of a
+    masked full-cache select. This is the request-level analogue of the
+    reference's in-flight batch pipeline (request_manager.cc:1829)."""
     cached = getattr(model, "_pp_segment_fn", None)
     if cached is not None:
         return cached
@@ -314,28 +325,62 @@ def _pp_segment(model, plan):
                         compute_dtype=jnp.dtype(model.config.compute_dtype),
                         batch_config=meta, mesh=mesh, config=model.config)
         stage = jax.lax.axis_index("pipe")
+        n_p = n_stages    # NOT named P: this module aliases PartitionSpec
+        R = x.shape[0]
+        M = max(m for m in range(1, n_p + 1) if R % m == 0)
+        rsize = R // M
 
-        def local_apply(x, k, v):
+        def local_apply(x_mb, k_mb, v_mb, meta_mb):
+            ctx.batch_config = meta_mb
+
             def body(carry, xs):
                 lp, kl, vl = xs
-                y, k2, v2 = _apply_block(model, plan, ctx, lp, kl, vl, carry)
+                y, k2, v2 = _apply_block(model, plan, ctx, lp, kl, vl,
+                                         carry)
                 return y, (k2, v2)
 
-            y, (k2, v2) = jax.lax.scan(body, x, (stacked, k, v))
+            y, (k2, v2) = jax.lax.scan(body, x_mb, (stacked, k_mb, v_mb))
             return y, k2, v2
 
-        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
-        buf = x
-        y = x
-        for t in range(n_stages):
-            y, k2, v2 = local_apply(buf, k, v)
-            keep = stage == t          # stage t held the real activation
-            k = jnp.where(keep, k2, k)
-            v = jnp.where(keep, v2, v)
-            if t < n_stages - 1:
+        def rows(a, start):
+            return jax.lax.dynamic_slice_in_dim(a, start * rsize, rsize,
+                                                axis=0)
+
+        perm = [(i, (i + 1) % n_p) for i in range(n_p)]
+        buf = jnp.zeros((rsize,) + x.shape[1:], x.dtype)
+        outbuf = jnp.zeros_like(x)
+        for t in range(M + n_p - 1):
+            mb = t - stage                       # this stage's microbatch
+            valid = (mb >= 0) & (mb < M)
+            mbc = jnp.clip(mb, 0, M - 1)
+            # stage 0 ingests microbatch t; later stages take the handoff
+            x_in = jax.lax.slice_in_dim(x, min(t, M - 1) * rsize,
+                                        min(t, M - 1) * rsize + rsize,
+                                        axis=0)
+            cur = jnp.where(stage == 0, x_in, buf)
+            meta_mb = jax.tree.map(lambda f: rows(f, mbc), meta)
+            k_mb = jax.lax.dynamic_slice_in_dim(k, mbc * rsize, rsize,
+                                                axis=1)
+            v_mb = jax.lax.dynamic_slice_in_dim(v, mbc * rsize, rsize,
+                                                axis=1)
+            y, k2, v2 = local_apply(cur, k_mb, v_mb, meta_mb)
+            # commit only the active microbatch's KV rows
+            k2 = jnp.where(valid, k2, k_mb)
+            v2 = jnp.where(valid, v2, v_mb)
+            k = jax.lax.dynamic_update_slice_in_dim(k, k2, mbc * rsize,
+                                                    axis=1)
+            v = jax.lax.dynamic_update_slice_in_dim(v, v2, mbc * rsize,
+                                                    axis=1)
+            # the last stage finished microbatch mb this tick
+            take = (stage == n_p - 1) & valid
+            cur_rows = rows(outbuf, mbc)
+            outbuf = jax.lax.dynamic_update_slice_in_dim(
+                outbuf, jnp.where(take, y, cur_rows), mbc * rsize, axis=0)
+            if t < M + n_p - 2:
                 buf = jax.lax.ppermute(y, "pipe", perm)
         out = jax.lax.psum(
-            jnp.where(stage == n_stages - 1, y, jnp.zeros_like(y)), "pipe")
+            jnp.where(stage == n_p - 1, outbuf, jnp.zeros_like(outbuf)),
+            "pipe")
         return out, k, v
 
     pipe_spec = jax.tree.map(lambda _: P("pipe"),
