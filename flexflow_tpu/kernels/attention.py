@@ -80,10 +80,55 @@ def _kernel(len_ref,                       # scalar prefetch: [R] int32
             acc, m, l, kbuf, vbuf, bbuf, sem,
             *, BS: int, causal: bool, has_bias: bool, has_alibi: bool,
             qk_scale: float, G: int, Q: int, layer_idx):
-    r = pl.program_id(0)
-    length = len_ref[r]
-    nb = (length + jnp.asarray(BS - 1, length.dtype)) // BS
+    _stream_attend(len_ref, None, q_ref, qp_ref, slopes_ref, None, None,
+                   bias_hbm, k_hbm, v_hbm, o_ref, acc, m, l, kbuf, vbuf,
+                   bbuf, sem, None, BS=BS, causal=causal, has_bias=has_bias,
+                   has_alibi=has_alibi, qk_scale=qk_scale, G=G, Q=Q,
+                   layer_idx=layer_idx)
 
+
+def _append_kernel(len_ref, appos_ref,     # scalar prefetch: [R] int32 each
+                   q_ref, qp_ref, slopes_ref, knew_ref, vnew_ref, bias_hbm,
+                   k_hbm, v_hbm,
+                   o_ref, ok_hbm, ov_hbm,
+                   acc, m, l, kbuf, vbuf, bbuf, sem, asem,
+                   *, BS: int, causal: bool, has_bias: bool,
+                   has_alibi: bool, qk_scale: float, G: int, Q: int,
+                   layer_idx):
+    """Decode-step variant: this step's single new token's K/V rows land at
+    cache position ``appos[r]`` IN PLACE (the caches are aliased in/out),
+    fused with the attention stream — replacing the XLA Q=1 row scatter
+    that cost ~1.6 ms/step at 7B geometry (R*KH*L = 16K scalar-unit rows).
+    The new rows are merged into the streamed VMEM block (so attention
+    sees the post-append cache with zero extra latency) and the aligned
+    8-row window containing p is written back asynchronously (Mosaic DMA
+    slices of [.., S, D] need SUBLANE-aligned S): rows [pb, p) re-land
+    bitwise-identical, row p gets the new K/V, rows (p, pb+8) re-land
+    whatever garbage they held (beyond ``length``, never attended).
+    Write-backs touch only row r's slice, so they never race the
+    cross-program prefetch of other rows."""
+    _stream_attend(len_ref, appos_ref, q_ref, qp_ref, slopes_ref, knew_ref,
+                   vnew_ref, bias_hbm, ok_hbm, ov_hbm, o_ref, acc, m, l,
+                   kbuf, vbuf, bbuf, sem, asem, BS=BS, causal=causal,
+                   has_bias=has_bias, has_alibi=has_alibi,
+                   qk_scale=qk_scale, G=G, Q=Q, layer_idx=layer_idx)
+
+
+def _stream_attend(len_ref, appos_ref, q_ref, qp_ref, slopes_ref, knew_ref,
+                   vnew_ref, bias_hbm, k_hbm, v_hbm, o_ref,
+                   acc, m, l, kbuf, vbuf, bbuf, sem, asem,
+                   *, BS: int, causal: bool, has_bias: bool,
+                   has_alibi: bool, qk_scale: float, G: int, Q: int,
+                   layer_idx):
+    has_append = appos_ref is not None
+    r = pl.program_id(0)
+    R = len_ref.shape[0]
+    length = len_ref[r]
+
+    def nb_of(j):
+        return (len_ref[j] + jnp.asarray(BS - 1, jnp.int32)) // BS
+
+    nb = nb_of(r)
     acc[:] = jnp.zeros_like(acc)
     m[:] = jnp.full_like(m, NEG_INF)
     l[:] = jnp.zeros_like(l)
@@ -95,42 +140,91 @@ def _kernel(len_ref,                       # scalar prefetch: [R] int32
         k_hbm = k_hbm.at[layer_idx]
         v_hbm = v_hbm.at[layer_idx]
 
-    def dmas(slot, i):
+    # Cross-program DMA pipeline: the R grid programs run sequentially on
+    # one core, so each program's FIRST block fetch is started by its
+    # predecessor (the last live program before it) and each program's
+    # last iteration hands off to the next live program. Slot parity runs
+    # over the GLOBAL block sequence g (sum of predecessors' block counts
+    # + local index), so producer and consumer agree on the buffer slot.
+    # Without this, every program eats its first fetch's full HBM latency
+    # serially — measured ~1/3 of the whole kernel time at decode shapes
+    # (nb == 1-2, where in-program double buffering never engages).
+    g0 = jax.lax.fori_loop(
+        0, R, lambda j, a: a + jnp.where(j < r, nb_of(j), 0), jnp.int32(0))
+    prev_live = jax.lax.fori_loop(
+        0, R, lambda j, a: a | ((j < r) & (nb_of(j) > 0)), False)
+    r_next = jax.lax.fori_loop(
+        0, R, lambda j, a: jnp.where((j > r) & (nb_of(j) > 0)
+                                     & (a == R), j, a), jnp.int32(R))
+
+    def dmas(row, slot, i):
         yield pltpu.make_async_copy(
-            k_hbm.at[r, :, pl.ds(i * BS, BS)], kbuf.at[slot],
+            k_hbm.at[row, :, pl.ds(i * BS, BS)], kbuf.at[slot],
             sem.at[slot, 0])
         yield pltpu.make_async_copy(
-            v_hbm.at[r, :, pl.ds(i * BS, BS)], vbuf.at[slot],
+            v_hbm.at[row, :, pl.ds(i * BS, BS)], vbuf.at[slot],
             sem.at[slot, 1])
         if has_bias:
             yield pltpu.make_async_copy(
-                bias_hbm.at[r, :, pl.ds(i * BS, BS)], bbuf.at[slot],
+                bias_hbm.at[row, :, pl.ds(i * BS, BS)], bbuf.at[slot],
                 sem.at[slot, 2])
 
-    def start_dmas(slot, i):
-        for d in dmas(slot, i):
+    def start_dmas(row, slot, i):
+        for d in dmas(row, slot, i):
             d.start()
 
-    def wait_dmas(slot, i):
-        for d in dmas(slot, i):
+    def wait_dmas(row, slot, i):
+        for d in dmas(row, slot, i):
             d.wait()
 
-    @pl.when(nb > 0)
-    def _():
-        start_dmas(0, 0)
+    @pl.when((nb > 0) & jnp.logical_not(prev_live))
+    def _():                              # first live program self-starts
+        start_dmas(r, g0 % 2, 0)
 
     qt = q_ref[0]                                   # [KH, GQ, D]
     GQ = qt.shape[1]
     qp = qp_ref[r]                                  # [GQ] absolute positions
+    if has_append:
+        p_app = appos_ref[r]
+        bp = p_app // BS                  # block holding the new position
 
     def body(i, _):
-        slot = i % 2
+        slot = (g0 + i) % 2
+        nxt_slot = (g0 + i + 1) % 2
 
         @pl.when(i + 1 < nb)
         def _():
-            start_dmas((i + 1) % 2, i + 1)
+            start_dmas(r, nxt_slot, i + 1)
 
-        wait_dmas(slot, i)
+        @pl.when((i + 1 == nb) & (r_next < R))
+        def _():                          # hand off to the next live row
+            start_dmas(r_next, nxt_slot, 0)
+
+        wait_dmas(r, slot, i)
+        if has_append:
+            @pl.when(i == bp)
+            def _():
+                # merge the new K/V row into the streamed block in VMEM
+                # (bitwise-identical to appending before the stream), and
+                # write back the aligned 8-row window it lives in
+                KH, D = kbuf.shape[1], kbuf.shape[3]
+                pm = p_app - bp * BS
+                sel = jax.lax.broadcasted_iota(
+                    jnp.int32, (KH, BS, D), 1) == pm
+                kbuf[slot] = jnp.where(sel, knew_ref[0, 0][:, None, :],
+                                       kbuf[slot])
+                vbuf[slot] = jnp.where(sel, vnew_ref[0, 0][:, None, :],
+                                       vbuf[slot])
+                wo = (pm // SUBLANE) * SUBLANE
+                pb_abs = (p_app // SUBLANE) * SUBLANE
+                wk = pltpu.make_async_copy(
+                    kbuf.at[slot, :, pl.ds(wo, SUBLANE)],
+                    k_hbm.at[r, :, pl.ds(pb_abs, SUBLANE)], asem.at[0])
+                wv = pltpu.make_async_copy(
+                    vbuf.at[slot, :, pl.ds(wo, SUBLANE)],
+                    v_hbm.at[r, :, pl.ds(pb_abs, SUBLANE)], asem.at[1])
+                wk.start()
+                wv.start()
         k = kbuf[slot]                              # [KH, BS, D]
         v = vbuf[slot]
         # scores[kh, gq, s] = q[kh, gq, :] . k[kh, s, :]
@@ -163,6 +257,24 @@ def _kernel(len_ref,                       # scalar prefetch: [R] int32
             preferred_element_type=jnp.float32)     # [KH, GQ, D]
         acc[:] = acc[:] * corr + pv
         m[:] = m_new
+        if has_append:
+            @pl.when(i == bp)
+            def _():
+                # the write-back must land before this program ends (the
+                # buffer slot is reused two global blocks later, and the
+                # next layer's kernel reads the region through the alias)
+                KH, D = kbuf.shape[1], kbuf.shape[3]
+                pm = p_app - bp * BS
+                wo = (pm // SUBLANE) * SUBLANE
+                pb_abs = (p_app // SUBLANE) * SUBLANE
+                pltpu.make_async_copy(
+                    kbuf.at[slot, :, pl.ds(wo, SUBLANE)],
+                    k_hbm.at[r, :, pl.ds(pb_abs, SUBLANE)],
+                    asem.at[0]).wait()
+                pltpu.make_async_copy(
+                    vbuf.at[slot, :, pl.ds(wo, SUBLANE)],
+                    v_hbm.at[r, :, pl.ds(pb_abs, SUBLANE)],
+                    asem.at[1]).wait()
         return 0
 
     jax.lax.fori_loop(0, nb, body, 0)
@@ -174,7 +286,7 @@ def _kernel(len_ref,                       # scalar prefetch: [R] int32
     static_argnames=("causal", "qk_scale", "interpret", "out_dtype",
                      "layer_idx"))
 def flash_attend(q, k_cache, v_cache, lengths, qpos, bias=None,
-                 alibi=None, *, causal=True, qk_scale=None,
+                 alibi=None, append_kv=None, *, causal=True, qk_scale=None,
                  out_dtype=None, layer_idx=None, interpret=False):
     """Batched KV-cache attention.
 
@@ -186,7 +298,14 @@ def flash_attend(q, k_cache, v_cache, lengths, qpos, bias=None,
     qpos     [R, Q] int32   absolute position of each query token
     bias     [R, Q, S] f32  optional additive mask (tree mask; NEG_INF=hidden)
     alibi    [H] f32        optional ALiBi slopes
-    returns  [R, Q, H*D]
+    append_kv  (k_new [R, 1, KH, D], v_new same, appos [R] int32)
+                            decode fused append: write each row's new K/V at
+                            cache position appos[r] (appos < 0 = skip row)
+                            IN PLACE before attending — the caches are
+                            aliased in/out and the call returns
+                            (out, k_cache, v_cache); callers must treat the
+                            passed caches as consumed (donated)
+    returns  [R, Q, H*D], or (out, k_cache, v_cache) with append_kv
     """
     R, Q, H, D = q.shape
     KH, S = k_cache.shape[-3], k_cache.shape[-2]
@@ -217,61 +336,92 @@ def flash_attend(q, k_cache, v_cache, lengths, qpos, bias=None,
     # Clamp: an out-of-range length would DMA past the cache end.
     lengths = jnp.minimum(lengths.astype(jnp.int32), S)
 
+    cache_dt = k_cache.dtype
+    kv_bytes = 2 * 2 * BS * KH * D * cache_dt.itemsize
+    compiler_params = pltpu.CompilerParams(
+        vmem_limit_bytes=int(min(
+            128 * 1024 * 1024,
+            8 * (KH * GQ * (D + 2) * 4 + KH * GQ * D * 2
+                 + kv_bytes + 2 * Q * BS * 4) + 1024 * 1024)),
+    )
+    cost_estimate = pl.CostEstimate(
+        flops=4 * R * GQ * KH * D * S,
+        bytes_accessed=2 * R * S * KH * D * cache_dt.itemsize,
+        transcendentals=R * KH * GQ * S,
+    )
+    qkv_in_specs = [
+        pl.BlockSpec((1, KH, GQ, D), lambda r, *_: (r, 0, 0, 0),
+                     memory_space=pltpu.VMEM),                   # qt
+        pl.BlockSpec(memory_space=pltpu.VMEM),                   # qp [R, GQ]
+        pl.BlockSpec((KH, GQ), lambda r, *_: (0, 0),
+                     memory_space=pltpu.VMEM),                   # slopes
+    ]
+    tail_in_specs = [
+        pl.BlockSpec(memory_space=pl.ANY),                       # bias (HBM)
+        pl.BlockSpec(memory_space=pl.ANY),                       # k cache
+        pl.BlockSpec(memory_space=pl.ANY),                       # v cache
+    ]
+    o_spec = pl.BlockSpec((1, KH, GQ, D), lambda r, *_: (r, 0, 0, 0),
+                          memory_space=pltpu.VMEM)
+    scratch = [
+        pltpu.VMEM((KH, GQ, D), jnp.float32),                    # acc
+        pltpu.VMEM((KH, GQ, 1), jnp.float32),                    # m
+        pltpu.VMEM((KH, GQ, 1), jnp.float32),                    # l
+        pltpu.VMEM((2, KH, BS, D), cache_dt),                    # k buf
+        pltpu.VMEM((2, KH, BS, D), cache_dt),                    # v buf
+        pltpu.VMEM((2, Q, BS), jnp.float32),                     # bias buf
+        pltpu.SemaphoreType.DMA((2, 3)),
+    ]
+
+    if append_kv is None:
+        kern = functools.partial(
+            _kernel, BS=BS, causal=causal, has_bias=has_bias,
+            has_alibi=has_alibi, qk_scale=float(qk_scale), G=G, Q=Q,
+            layer_idx=layer_idx)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1, grid=(R,),
+            in_specs=qkv_in_specs + tail_in_specs,
+            out_specs=o_spec, scratch_shapes=scratch)
+        out = pl.pallas_call(
+            kern, grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((R, KH, GQ, D), out_dtype),
+            compiler_params=compiler_params, cost_estimate=cost_estimate,
+            interpret=interpret,
+        )(lengths.astype(jnp.int32), qt, qp_gq, slopes_gq,
+          bias.astype(jnp.float32), k_cache, v_cache)
+        # [R, KH, G*Q, D] -> [R, Q, H*D] with h = kh*G + g
+        return out.reshape(R, KH, G, Q, D).transpose(0, 3, 1, 2, 4).reshape(
+            R, Q, H * D)
+
+    # fused decode append: write (k_new, v_new) at appos[r] in place, then
+    # attend; the caches alias through to the outputs (donation-safe)
+    k_new, v_new, appos = append_kv
     kern = functools.partial(
-        _kernel, BS=BS, causal=causal, has_bias=has_bias,
+        _append_kernel, BS=BS, causal=causal, has_bias=has_bias,
         has_alibi=has_alibi, qk_scale=float(qk_scale), G=G, Q=Q,
         layer_idx=layer_idx)
-
-    cache_dt = k_cache.dtype
+    knew_spec = pl.BlockSpec((1, 1, KH, D), lambda r, *_: (r, 0, 0, 0),
+                             memory_space=pltpu.VMEM)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(R,),
-        in_specs=[
-            pl.BlockSpec((1, KH, GQ, D), lambda r, *_: (r, 0, 0, 0),
-                         memory_space=pltpu.VMEM),               # qt
-            pl.BlockSpec(memory_space=pltpu.VMEM),               # qp [R, GQ]
-            pl.BlockSpec((KH, GQ), lambda r, *_: (0, 0),
-                         memory_space=pltpu.VMEM),               # slopes
-            pl.BlockSpec(memory_space=pl.ANY),                   # bias (HBM)
-            pl.BlockSpec(memory_space=pl.ANY),                   # k cache
-            pl.BlockSpec(memory_space=pl.ANY),                   # v cache
-        ],
-        out_specs=pl.BlockSpec((1, KH, GQ, D), lambda r, *_: (r, 0, 0, 0),
-                               memory_space=pltpu.VMEM),
-        scratch_shapes=[
-            pltpu.VMEM((KH, GQ, D), jnp.float32),                # acc
-            pltpu.VMEM((KH, GQ, 1), jnp.float32),                # m
-            pltpu.VMEM((KH, GQ, 1), jnp.float32),                # l
-            pltpu.VMEM((2, KH, BS, D), cache_dt),                # k buf
-            pltpu.VMEM((2, KH, BS, D), cache_dt),                # v buf
-            pltpu.VMEM((2, Q, BS), jnp.float32),                 # bias buf
-            pltpu.SemaphoreType.DMA((2, 3)),
-        ],
-    )
-    kv_bytes = 2 * 2 * BS * KH * D * cache_dt.itemsize
-    out = pl.pallas_call(
-        kern,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((R, KH, GQ, D), out_dtype),
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=int(min(
-                128 * 1024 * 1024,
-                8 * (KH * GQ * (D + 2) * 4 + KH * GQ * D * 2
-                     + kv_bytes + 2 * Q * BS * 4) + 1024 * 1024)),
-        ),
-        cost_estimate=pl.CostEstimate(
-            flops=4 * R * GQ * KH * D * S,
-            bytes_accessed=2 * R * S * KH * D * cache_dt.itemsize,
-            transcendentals=R * KH * GQ * S,
-        ),
+        num_scalar_prefetch=2, grid=(R,),
+        in_specs=qkv_in_specs + [knew_spec, knew_spec] + tail_in_specs,
+        out_specs=(o_spec, pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)),
+        scratch_shapes=scratch + [pltpu.SemaphoreType.DMA((2,))])
+    out, k_out, v_out = pl.pallas_call(
+        kern, grid_spec=grid_spec,
+        out_shape=(jax.ShapeDtypeStruct((R, KH, GQ, D), out_dtype),
+                   jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+                   jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype)),
+        input_output_aliases={8: 1, 9: 2},   # k/v cache operands -> outputs
+        compiler_params=compiler_params, cost_estimate=cost_estimate,
         interpret=interpret,
-    )(lengths.astype(jnp.int32), qt, qp_gq, slopes_gq,
+    )(lengths.astype(jnp.int32), appos.astype(jnp.int32), qt, qp_gq,
+      slopes_gq, k_new.astype(cache_dt), v_new.astype(cache_dt),
       bias.astype(jnp.float32), k_cache, v_cache)
-
-
-    # [R, KH, G*Q, D] -> [R, Q, H*D] with h = kh*G + g
-    return out.reshape(R, KH, G, Q, D).transpose(0, 3, 1, 2, 4).reshape(
+    out = out.reshape(R, KH, G, Q, D).transpose(0, 3, 1, 2, 4).reshape(
         R, Q, H * D)
+    return out, k_out, v_out
 
 
 def reference_attend(q, k_cache, v_cache, lengths, qpos, bias=None,

@@ -99,13 +99,22 @@ def _iter_fields(buf: bytes):
             v, pos = _read_varint(buf, pos)
             yield fnum, wtype, v
         elif wtype == 1:
+            if pos + 8 > n:
+                raise ValueError("truncated 64-bit field")
             yield fnum, wtype, buf[pos:pos + 8]
             pos += 8
         elif wtype == 2:
             ln, pos = _read_varint(buf, pos)
+            if pos + ln > n:
+                # match the C++ parser's strictness (Reader::sub sets
+                # ok=false): a silently clamped slice would let the Python
+                # twin "parse" a corrupt .model the native path rejects
+                raise ValueError("truncated length-delimited field")
             yield fnum, wtype, buf[pos:pos + ln]
             pos += ln
         elif wtype == 5:
+            if pos + 4 > n:
+                raise ValueError("truncated 32-bit field")
             yield fnum, wtype, buf[pos:pos + 4]
             pos += 4
         else:

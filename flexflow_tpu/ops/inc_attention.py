@@ -95,6 +95,9 @@ def append_kv(cache: jnp.ndarray, new: jnp.ndarray, start_pos: jnp.ndarray,
     return cache.at[rows, :, cols].set(new.astype(cache.dtype), mode="drop")
 
 
+_append_kv_fn = append_kv   # alias: _attend's append_kv kwarg shadows it
+
+
 def append_kv_stacked(stack: jnp.ndarray, layer_idx: int, new: jnp.ndarray,
                       start_pos: jnp.ndarray, num_tokens: jnp.ndarray,
                       active: jnp.ndarray) -> jnp.ndarray:
@@ -152,7 +155,7 @@ def alibi_slopes(num_heads: int) -> jnp.ndarray:
 
 
 def _attend(attrs, q, k_cache, v_cache, lengths, qpos, out_dtype, ctx,
-            bias=None, causal=True, layer_idx=None):
+            bias=None, causal=True, layer_idx=None, append_kv=None):
     """q [R,Q,H,D] x cache [R,KH,S,D] -> [R, Q, H*D].
 
     With ``layer_idx`` the caches are the full stacked [L, R, KH, S, D]
@@ -164,6 +167,15 @@ def _attend(attrs, q, k_cache, v_cache, lengths, qpos, out_dtype, ctx,
     (finished/inactive slots pass 0 and cost nothing on the Pallas path);
     ``qpos`` [R, Q] absolute query positions drive causal masking + ALiBi;
     ``bias`` [R, Q, S] is the additive tree mask for verification.
+
+    ``append_kv = (k_new [R, 1, KH, D], v_new same, appos [R])`` fuses the
+    decode-step KV append into the kernel: each row's new K/V rows land at
+    cache position appos[r] (appos < 0 = skip) via in-place DMA before the
+    stream — replacing the XLA row scatter that cost ~1.6 ms/step at 7B
+    (R*KH*L scalar-unit rows). Returns (out, new_k_cache, new_v_cache);
+    the passed caches are consumed (aliased through the kernel). The jnp
+    path performs the same append with the scatter, so semantics are
+    identical everywhere.
     """
     from flexflow_tpu import kernels as ffk
     from flexflow_tpu.kernels.attention import flash_attend, reference_attend
@@ -193,18 +205,42 @@ def _attend(attrs, q, k_cache, v_cache, lengths, qpos, out_dtype, ctx,
     else:
         ffk.record_fast_path()
         R, H = q.shape[0], q.shape[2]
-        out = flash_attend(
+        fkv = None
+        if append_kv is not None:
+            k_new, v_new, appos = append_kv           # [R, 1, KH, D] each
+            fkv = (_pad_d(k_new, Dp), _pad_d(v_new, Dp), appos)
+        res = flash_attend(
             _pad_d(q, Dp), k_cache, v_cache, lengths, qpos, bias=bias,
-            alibi=alibi, causal=causal, qk_scale=scale, out_dtype=out_dtype,
-            layer_idx=layer_idx, interpret=ffk.pallas_interpret_forced())
+            alibi=alibi, append_kv=fkv, causal=causal, qk_scale=scale,
+            out_dtype=out_dtype, layer_idx=layer_idx,
+            interpret=ffk.pallas_interpret_forced())
+        out, caches = (res, ()) if append_kv is None else (res[0], res[1:])
         if Dp != D:                 # drop the per-head lane padding
             out = out.reshape(R, Q, H, Dp)[..., :D].reshape(R, Q, H * D)
-        return out
+        return out if append_kv is None else (out,) + caches
+    new_caches = ()
+    if append_kv is not None:
+        k_new, v_new, appos = append_kv
+        valid = appos >= 0
+        start = jnp.maximum(appos, 0)
+        num = valid.astype(jnp.int32)
+        kp, vp = _pad_d(k_new, Dp), _pad_d(v_new, Dp)
+        if layer_idx is not None:
+            k_cache = append_kv_stacked(k_cache, layer_idx, kp, start, num,
+                                        valid)
+            v_cache = append_kv_stacked(v_cache, layer_idx, vp, start, num,
+                                        valid)
+        else:
+            k_cache = _append_kv_fn(k_cache, kp, start, num, valid)
+            v_cache = _append_kv_fn(v_cache, vp, start, num, valid)
+        new_caches = (k_cache, v_cache)
+    kc, vc = k_cache, v_cache
     if layer_idx is not None:
-        k_cache, v_cache = k_cache[layer_idx], v_cache[layer_idx]
-    return reference_attend(
-        q, k_cache[..., :D], v_cache[..., :D], lengths, qpos, bias=bias,
+        kc, vc = k_cache[layer_idx], v_cache[layer_idx]
+    out = reference_attend(
+        q, kc[..., :D], vc[..., :D], lengths, qpos, bias=bias,
         alibi=alibi, causal=causal, qk_scale=scale, out_dtype=out_dtype)
+    return out if append_kv is None else (out,) + new_caches
 
 
 def _weight_specs(attrs, input_specs):
@@ -445,13 +481,38 @@ class IncMultiHeadSelfAttention(OpImpl):
                                       attrs.get("rope_theta", 10000.0), q.dtype)
             q = apply_rotary(q, cos, sin)
             k = apply_rotary(k, cos, sin)
-        k_ref, v_ref, layer_idx = append_and_ref(
-            ctx, attrs, k, v, meta.start_pos, meta.num_tokens, meta.active)
         # Causal over absolute cache positions: query token i (at position
         # start+i) sees cache[s] for s <= start+i (enforced in the kernel).
         Q = x.shape[1]
         q_abs = meta.start_pos[:, None] + jnp.arange(Q)[None, :]   # [R,Q]
         lengths = jnp.where(meta.active, meta.start_pos + meta.num_tokens, 0)
+        append_q = getattr(ctx, "kv_append_q", None)
+        eff_q = append_q if (append_q is not None and Q > append_q) else Q
+        if eff_q == 1 and getattr(ctx, "kv_override", None) is None:
+            # single new real token per row (decode; verify-consistent
+            # wide decode has 1 real + padding tokens): fuse the KV append
+            # into the attention kernel instead of an XLA row scatter
+            idx = attrs.get("cache_layer_idx")
+            if idx is None:
+                st = ctx.state_in[ctx.layer_name]
+                k0, v0 = st["k_cache"], st["v_cache"]
+            else:          # full stacked [L, R, KH, S, D] buffers
+                st = ctx.state_out.get("kv_cache") or ctx.state_in["kv_cache"]
+                k0, v0 = st["k"], st["v"]
+            S = k0.shape[-2]
+            appos = jnp.where(
+                meta.active & (meta.num_tokens > 0) & (meta.start_pos < S),
+                meta.start_pos, -1)
+            out, knew, vnew = _attend(
+                attrs, q, k0, v0, lengths, q_abs, x.dtype, ctx, causal=True,
+                layer_idx=idx, append_kv=(k[:, :1], v[:, :1], appos))
+            if idx is None:
+                write_kv(ctx, attrs, knew, vnew)
+            else:
+                ctx.state_out["kv_cache"] = {"k": knew, "v": vnew}
+            return [_project_out(attrs, params, ctx, out)]
+        k_ref, v_ref, layer_idx = append_and_ref(
+            ctx, attrs, k, v, meta.start_pos, meta.num_tokens, meta.active)
         out = _attend(attrs, q, k_ref, v_ref, lengths, q_abs, x.dtype,
                       ctx, causal=True, layer_idx=layer_idx)
         return [_project_out(attrs, params, ctx, out)]

@@ -49,17 +49,20 @@ def build_feeds(model, meta):
 
 
 def forward_with_meta(model, params, state, meta, rng, compute_dtype,
-                      kv_contiguous=False):
+                      kv_contiguous=False, kv_append_q=None):
     """One serving forward over a BatchMeta inside jit — the single traced
     body shared by InferenceManager.step and the fused engines.
 
     ``kv_contiguous=True`` (fused engines only) promises every active
     row's append region [start, start+Q) is in bounds, unlocking the
     scatter-free dynamic_update_slice KV append (inc_attention.py
-    append_kv_contiguous)."""
+    append_kv_contiguous). ``kv_append_q`` (verify-consistent decode)
+    declares that only the first kv_append_q tokens per row are real, so
+    the KV append can skip the padding columns entirely."""
     ctx = OpContext(training=False, rng=rng, compute_dtype=compute_dtype,
                     batch_config=meta, mesh=model.mesh, config=model.config)
     ctx.kv_contiguous = kv_contiguous
+    ctx.kv_append_q = kv_append_q
     values, new_state = model._run_graph(params, build_feeds(model, meta),
                                          ctx, state)
     return values[model._final_tensor.tensor_id], new_state
@@ -108,7 +111,7 @@ def make_draft_chain(model, compute_dtype, depth: int):
     return jax.jit(chain, donate_argnums=(1,))
 
 
-def make_decode_block(model, compute_dtype, max_steps: int):
+def make_decode_block(model, compute_dtype, max_steps: int, width: int = 1):
     """Build the jitted dynamic-length decode program for ``model``.
 
     Signature: (params, op_state, tok [R], pos [R], active [R], rng,
@@ -116,6 +119,14 @@ def make_decode_block(model, compute_dtype, max_steps: int):
     last_tok [R]). Only the first n columns are meaningful; the rest stay 0.
     ``pos[r]`` is the sequence index of the pending token ``tok[r]``.
     One program compiles for ALL n (dynamic while_loop trip count).
+
+    ``width > 1`` runs each step at the spec verify pass's token width
+    with 1 real token per row (verify-consistent decode: identical gemm
+    shapes and attention-kernel instantiation, so near-tie argmaxes
+    resolve the same way in both paths). Only the real token's KV is
+    appended (kv_append_q=1) — the padding rows' KV is never attended —
+    via the attention kernel's fused in-place append (inc_attention._attend
+    append_kv), so no staging window needs reserving near the cache end.
     """
 
     def block(params, op_state, tok, pos, active, rng, n):
@@ -129,9 +140,25 @@ def make_decode_block(model, compute_dtype, max_steps: int):
 
         def body(carry):
             i, state, tok, pos, out = carry
-            o, state = _forward_tokens(
-                model, params, state, tok[:, None], pos[:, None], pos, num,
-                active, jax.random.fold_in(rng, i), compute_dtype)
+            if width == 1:
+                o, state = _forward_tokens(
+                    model, params, state, tok[:, None], pos[:, None], pos,
+                    num, active, jax.random.fold_in(rng, i), compute_dtype)
+            else:
+                # verify-consistent decode: same token width as the spec
+                # verify pass, 1 real token (num_tokens = active). The
+                # chain tree's ancestor mask IS the causal mask, so the
+                # plain causal path computes bitwise-identical row-0
+                # results without building / DMA-ing the [R, Q, S] tree
+                # bias (~7% of an 8-layer decode step).
+                R = tok.shape[0]
+                toks = jnp.zeros((R, width), jnp.int32).at[:, 0].set(tok)
+                qpos = pos[:, None] + jnp.arange(width)[None, :]
+                meta = BatchMeta(tokens=toks, positions=qpos, start_pos=pos,
+                                 num_tokens=num, active=active)
+                o, state = forward_with_meta(
+                    model, params, state, meta, jax.random.fold_in(rng, i),
+                    compute_dtype, kv_append_q=1)
             nxt = o[:, 0].astype(jnp.int32)
             out = jax.lax.dynamic_update_slice(out, nxt[:, None], (0, i))
             return i + 1, state, nxt, pos + 1, out

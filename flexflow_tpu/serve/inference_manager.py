@@ -39,6 +39,32 @@ class InferenceManager:
         self._rng = jax.random.PRNGKey(cfg.seed)
         self._decode_block = None
         self._debug_step = 0
+        self.decode_width = self._resolve_decode_width(cfg)
+
+    @staticmethod
+    def _resolve_decode_width(cfg) -> int:
+        """Step width for fused incremental decode (config.decode_width;
+        0 = auto). Widths > 1 make decode verify-consistent — identical
+        program shapes to the spec verify pass, so near-tie argmaxes
+        resolve identically in both (the reference's spec-vs-incr 30-token
+        CI gate). Auto picks the sublane-padded single-SSM verify width
+        only when the Pallas kernel will actually serve this config
+        (use_pallas AND a tileable cache length — mirroring _attend's
+        dispatch guard); everywhere else the jnp path runs in fp32 with no
+        bf16 near-tie problem, so wide queries would be pure waste."""
+        if cfg.decode_width:
+            return int(cfg.decode_width)
+        from flexflow_tpu import kernels as ffk
+        from flexflow_tpu.kernels.attention import SUBLANE, supports_seq_len
+
+        if ffk.use_pallas(cfg) and supports_seq_len(cfg.max_sequence_length):
+            # SUBLANE == MultiSpecEngine.tree_width for the single-SSM
+            # depth-4 default (1 + 4 rounded up to the sublane), and the
+            # Pallas path always specs through that engine
+            # (request_manager.generate_spec_infer routes the chain engine
+            # off-TPU only) — so decode and verify really do share shapes.
+            return SUBLANE
+        return 1
 
     def _step_impl(self, params, op_state, meta, rng):
         from flexflow_tpu.serve.engine import forward_with_meta
@@ -93,7 +119,8 @@ class InferenceManager:
         if self._decode_block is None:
             self._decode_block = make_decode_block(
                 self.model, self._compute_dtype,
-                self.model.config.decode_block_steps)
+                self.model.config.decode_block_steps,
+                width=self.decode_width)
         n_steps = min(int(n_steps), self.model.config.decode_block_steps)
         self._rng, step_rng = jax.random.split(self._rng)
         toks, new_state, _last = self._decode_block(
@@ -107,15 +134,21 @@ class InferenceManager:
         from flexflow_tpu.serve.batch_config import BatchMeta
 
         R = tok.shape[0]
+        W = self.decode_width     # keep the fused path's step width, so
         cur = np.asarray(tok, np.int32).copy()
         p = np.asarray(pos, np.int32).copy()
         act = np.asarray(active, bool)
         out = np.zeros((R, n_steps), np.int32)
         for j in range(n_steps):
+            # the dumped run reproduces the SAME tokens (a width-1 debug
+            # step would re-introduce exactly the wide-vs-narrow gemm
+            # tiling argmax divergence decode_width eliminates)
+            toks = np.zeros((R, W), np.int32)
+            toks[:, 0] = cur
+            qpos = p[:, None] + np.arange(W, dtype=np.int32)[None, :]
             meta = BatchMeta(
-                tokens=cur.reshape(R, 1), positions=p.reshape(R, 1),
-                start_pos=p.copy(), num_tokens=act.astype(np.int32),
-                active=act)
+                tokens=toks, positions=qpos, start_pos=p.copy(),
+                num_tokens=act.astype(np.int32), active=act)
             step_out = self.step(meta)            # dumps + advances caches
             nxt = np.asarray(step_out).reshape(R, -1)[:, 0].astype(np.int32)
             out[:, j] = np.where(act, nxt, 0)

@@ -257,7 +257,10 @@ class RequestManager:
                     if req is not None and not req.finished]
             if live:
                 # dynamic trip count: exactly the steps still needed, one
-                # compiled program regardless of size (engine.py)
+                # compiled program regardless of size (engine.py). The
+                # verify-consistent wide decode (decode_width > 1) appends
+                # only the real token's KV (kv_append_q), so no staging
+                # window needs reserving near the cache end.
                 block = min(
                     max(self._remaining_budget(req, max_seq) for req in live),
                     cfg.decode_block_steps)

@@ -175,3 +175,84 @@ def test_fallback_is_recorded_and_warned(monkeypatch):
         _attend(attrs, q, k, k, lengths, qpos, jnp.float32, None)
     assert sum(ffk.fallback_counts.values()) == 2
     assert len([x for x in w if "jnp path" in str(x.message)]) == 1  # once
+
+
+def test_flash_fused_append_matches_scatter_oracle():
+    """The fused in-place KV append (flash_attend append_kv: in-stream
+    VMEM merge + aligned 8-row write-back + cache aliasing) must equal
+    scatter-append-then-attend, preserve every cache row outside the
+    aligned window, and skip appos<0 rows."""
+    R, Q, H, KH, D, S = 4, 8, 8, 4, 128, 256
+    q, k, v = _mk(R, Q, H, KH, D, S, seed=11)
+    rng = np.random.RandomState(13)
+    k_new = jnp.asarray(rng.randn(R, 1, KH, D).astype(np.float32))
+    v_new = jnp.asarray(rng.randn(R, 1, KH, D).astype(np.float32))
+    # row 3 inactive (appos=-1): nothing appended, nothing attended
+    appos = jnp.asarray([37, 0, 255, -1], jnp.int32)
+    lengths = jnp.asarray([38, 1, 256, 0], jnp.int32)
+    qpos = (appos.clip(0)[:, None] + jnp.arange(Q, dtype=jnp.int32)[None])
+
+    # oracle: scatter-append first, then plain attention
+    rows = jnp.arange(R)
+    valid = appos >= 0
+    cols = jnp.where(valid, appos, S)
+    k_ref = k.at[rows, :, cols.clip(0, S)].set(
+        jnp.where(valid[:, None, None], k_new[:, 0], k[rows, :, cols % S]),
+        mode="drop")
+    v_ref = v.at[rows, :, cols.clip(0, S)].set(
+        jnp.where(valid[:, None, None], v_new[:, 0], v[rows, :, cols % S]),
+        mode="drop")
+    ref = reference_attend(q, k_ref, v_ref, lengths, qpos)
+
+    out, k_out, v_out = flash_attend(
+        q, k, v, lengths, qpos, append_kv=(k_new, v_new, appos),
+        interpret=True)
+    _cmp(ref, out, lengths, 2e-5)
+    # appended rows landed; everything outside each row's aligned window
+    # is bitwise-preserved (the write-back may rewrite up to 8 rows)
+    k_out, v_out = np.asarray(k_out), np.asarray(v_out)
+    for r in range(R):
+        p = int(appos[r])
+        if p >= 0:
+            np.testing.assert_array_equal(k_out[r, :, p], k_new[r, 0])
+            np.testing.assert_array_equal(v_out[r, :, p], v_new[r, 0])
+            pb = (p // 8) * 8
+            keep = np.ones(S, bool)
+            keep[pb:pb + 8] = False
+            np.testing.assert_array_equal(k_out[r][:, keep],
+                                          np.asarray(k)[r][:, keep])
+            # committed rows inside the window below p are re-landed
+            # bitwise-identical
+            np.testing.assert_array_equal(k_out[r][:, pb:p],
+                                          np.asarray(k)[r][:, pb:p])
+        else:
+            np.testing.assert_array_equal(k_out[r], np.asarray(k)[r])
+            np.testing.assert_array_equal(v_out[r], np.asarray(v)[r])
+
+
+def test_flash_fused_append_stacked_layer():
+    """append_kv with the stacked [L, R, KH, S, D] cache + layer_idx:
+    only the selected layer's cache changes."""
+    L, R, Q, H, KH, D, S = 3, 2, 8, 4, 4, 128, 256
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(R, Q, H, D).astype(np.float32))
+    ks = jnp.asarray(rng.randn(L, R, KH, S, D).astype(np.float32))
+    vs = jnp.asarray(rng.randn(L, R, KH, S, D).astype(np.float32))
+    k_new = jnp.asarray(rng.randn(R, 1, KH, D).astype(np.float32))
+    v_new = jnp.asarray(rng.randn(R, 1, KH, D).astype(np.float32))
+    appos = jnp.asarray([10, 130], jnp.int32)
+    lengths = appos + 1
+    qpos = appos[:, None] + jnp.arange(Q, dtype=jnp.int32)[None]
+    out, k_out, v_out = flash_attend(
+        q, ks, vs, lengths, qpos, append_kv=(k_new, v_new, appos),
+        layer_idx=1, interpret=True)
+    k1 = jnp.asarray(ks[1]).at[jnp.arange(R), :, appos].set(k_new[:, 0])
+    v1 = jnp.asarray(vs[1]).at[jnp.arange(R), :, appos].set(v_new[:, 0])
+    ref = reference_attend(q, k1, v1, lengths, qpos)
+    _cmp(ref, out, lengths, 2e-5)
+    k_out = np.asarray(k_out)
+    np.testing.assert_array_equal(k_out[0], np.asarray(ks)[0])
+    np.testing.assert_array_equal(k_out[2], np.asarray(ks)[2])
+    for r in range(R):
+        np.testing.assert_array_equal(k_out[1, r, :, int(appos[r])],
+                                      k_new[r, 0])

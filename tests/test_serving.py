@@ -89,6 +89,33 @@ def test_max_sequence_length_respected():
     assert len(res.input_tokens) + len(res.output_tokens) <= 16
 
 
+def test_verify_consistent_decode_width_matches_width1():
+    """decode_width > 1 (verify-consistent decode: the pending token staged
+    as node 0 of a width-W window, same program shapes as the spec verify
+    pass — see FFConfig.decode_width) must produce the same tokens as the
+    width-1 path, including requests that run into the cache end (the
+    cramped single-step fallback)."""
+
+    def run(width, max_new=20, max_seq=64):
+        cfg = ff.FFConfig(max_requests_per_batch=4,
+                          max_sequence_length=max_seq,
+                          max_tokens_per_batch=16, seed=0,
+                          kv_cache_dtype="float32", decode_width=width)
+        model = ff.FFModel(cfg)
+        create_llama_model(model, TINY, mode=InferenceMode.INC_DECODING_MODE)
+        model.compile(comp_mode=ff.CompMode.COMP_MODE_INFERENCE)
+        rm = RequestManager()
+        for p in [[5, 9, 23, 44], [7, 3], [1, 2, 3]]:
+            rm.register_new_request(p, max_new_tokens=max_new)
+        return {tuple(r.input_tokens): r.output_tokens
+                for r in rm.generate_incr_decoding(model)}
+
+    assert run(8) == run(1)
+    # cramped: generation hits the cache end; the W-window path must hand
+    # the tail to the single-step fallback and still match
+    assert run(8, max_new=60, max_seq=40) == run(1, max_new=60, max_seq=40)
+
+
 def test_spec_infer_matches_incr_decoding():
     """With the SSM = the LLM's own weights, speculation must accept nearly
     everything and the output must be token-identical to incremental
