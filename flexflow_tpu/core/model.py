@@ -1086,8 +1086,15 @@ class FFModel:
             hit = stacked_param_lookup(self, layer_name, weight_name)
             if hit is not None:
                 pos, i = hit
-                return np.asarray(
-                    self.params[PP_PARAMS_KEY][pos][weight_name][i])
+                stack = self.params[PP_PARAMS_KEY][pos][weight_name]
+                if is_quantized(stack):
+                    from flexflow_tpu.quant import QuantizedWeight
+
+                    layer_qw = QuantizedWeight(stack.qtype, stack.q[i],
+                                               stack.scale[i], stack.rows,
+                                               stack.dtype)
+                    return np.asarray(dequantize_array(layer_qw))
+                return np.asarray(stack[i])
         leaf = self.params[layer_name][weight_name]
         if is_quantized(leaf):
             return np.asarray(dequantize_array(leaf))
@@ -1135,6 +1142,17 @@ class FFModel:
             if hit is not None:
                 pos, i = hit
                 stack = self.params[PP_PARAMS_KEY][pos][weight_name]
+                if is_quantized(stack):
+                    # re-quantize the block's new weights and splice the
+                    # payload+scale into the stage-stacked leaves
+                    arr = jnp.asarray(value, dtype=jnp.dtype(stack.dtype))
+                    # logical per-block shape (int4 packs two rows/byte)
+                    assert arr.shape == (stack.rows, stack.q.shape[-1]), (
+                        arr.shape, stack.rows, stack.q.shape)
+                    new = quantize_array(arr, stack.qtype)
+                    stack.q = stack.q.at[i].set(new.q)
+                    stack.scale = stack.scale.at[i].set(new.scale)
+                    return
                 arr = jnp.asarray(value, dtype=stack.dtype)
                 assert arr.shape == stack.shape[1:], (arr.shape, stack.shape)
                 self.params[PP_PARAMS_KEY][pos][weight_name] = \

@@ -77,6 +77,13 @@ class PipelinePlan:
         return self.blocks[0]
 
 
+def microbatch_count(R: int, P: int) -> int:
+    """GPipe split: M = largest divisor of R that is <= P (request slots
+    must split evenly for the static microbatch shapes). Shared by the
+    schedule and the compile-time degeneracy warning."""
+    return max(m for m in range(1, P + 1) if R % m == 0)
+
+
 def _block_index(name: str) -> Optional[int]:
     m = _BLOCK_IDX_RE.search(name)
     return int(m.group(1)) if m else None
@@ -176,6 +183,26 @@ def build_pipeline_plan(model, num_stages: int) -> Optional[PipelinePlan]:
         for t in l.inputs:
             if t.tensor_id in block_tids and t.tensor_id != exit_tid:
                 return None
+    # GPipe microbatching splits the R request slots into M = (largest
+    # divisor of R <= P) microbatches; a poorly-chosen R degrades silently
+    # (worst case prime R -> M=1: plain round-robin at 1/P utilization).
+    # Warn with the math at compile so the user picks R % P == 0
+    # (reference analogue: the depth-4 in-flight pipeline always engages,
+    # request_manager.cc:1829).
+    R = model.config.max_requests_per_batch
+    P_ = num_stages
+    M = microbatch_count(R, P_)
+    if M < P_:
+        import warnings
+
+        util = M / (M + P_ - 1)   # fraction of ticks each stage is busy
+        warnings.warn(
+            f"pipeline microbatching is degenerate: max_requests_per_batch="
+            f"{R} splits into only M={M} microbatches over {P_} stages "
+            f"(stage utilization {util:.0%}; M=P would give "
+            f"{P_ / (2 * P_ - 1):.0%}). Choose max_requests_per_batch "
+            f"divisible by pipeline_parallelism_degree={P_} (e.g. "
+            f"{-(-R // P_) * P_}).", stacklevel=2)
     return PipelinePlan(pre=layers[:start0], blocks=blocks, post=post,
                         entry_tid=entry_tid, exit_tid=exit_tid,
                         block_entry_tid=block_entry,
@@ -197,9 +224,18 @@ def finalize_pipeline(model) -> None:
             "pipeline_parallelism_degree > 1 does not compose with "
             "cpu_offload yet: stage-sharded weights are already 1/P per "
             "device; drop one of the two")
-    from flexflow_tpu.quant import is_quantized
+    from flexflow_tpu.quant import QuantizedWeight, is_quantized
 
     mesh = model.mesh
+
+    def shard_spec(shape, dims):
+        spec = ["pipe"]
+        for dim_size, ax in zip(shape, dims):
+            ok = (ax in mesh.shape and mesh.shape[ax] > 1
+                  and dim_size % mesh.shape[ax] == 0)
+            spec.append(ax if ok else None)
+        return NamedSharding(mesh, P(*spec))
+
     stacked: Dict[str, Dict[str, Any]] = {}
     for pos, tlayer in enumerate(plan.template):
         if not tlayer.weights:
@@ -208,18 +244,25 @@ def finalize_pipeline(model) -> None:
         for w in tlayer.weights:
             leaves = [model.params[plan.blocks[i][pos].name][w.name]
                       for i in range(plan.num_blocks)]
-            if any(is_quantized(l) for l in leaves):
-                raise NotImplementedError(
-                    "pipeline_parallelism_degree > 1 with int8/int4 "
-                    "quantized weights is not supported yet")
             dims = w.sharding_dims or (None,) * len(w.shape)
-            spec = ["pipe"]
-            for dim_size, ax in zip(w.shape, dims):
-                ok = (ax in mesh.shape and mesh.shape[ax] > 1
-                      and dim_size % mesh.shape[ax] == 0)
-                spec.append(ax if ok else None)
-            sharding = NamedSharding(mesh, P(*spec))
-            per_w[w.name] = jax.device_put(jnp.stack(leaves), sharding)
+            if is_quantized(leaves[0]):
+                # stack payload + scale separately (QuantizedWeight is a
+                # leaf-pair pytree; lax.scan over the stacked params then
+                # hands each block its own [rows, cols]/[cols] pair with
+                # the static aux intact — reference composes 4/8-bit with
+                # TP x PP serving too, config.h:144-163). Payload dims
+                # validate against the ACTUAL q shape (int4 packs rows).
+                t = leaves[0]
+                q = jax.device_put(jnp.stack([l.q for l in leaves]),
+                                   shard_spec(leaves[0].q.shape, dims))
+                sc = jax.device_put(
+                    jnp.stack([l.scale for l in leaves]),
+                    shard_spec(t.scale.shape, dims[-1:]))
+                per_w[w.name] = QuantizedWeight(t.qtype, q, sc, t.rows,
+                                                t.dtype)
+            else:
+                per_w[w.name] = jax.device_put(
+                    jnp.stack(leaves), shard_spec(w.shape, dims))
             for i in range(plan.num_blocks):
                 del model.params[plan.blocks[i][pos].name][w.name]
         stacked[str(pos)] = per_w
@@ -236,7 +279,8 @@ def finalize_pipeline(model) -> None:
 
 
 def stacked_param_lookup(model, layer_name: str, weight_name: str):
-    """(plan, pos, i) for a block layer's weight post-finalize, else None."""
+    """(pos, i) — block-local layer position (as the params key) and block
+    index — for a block layer's weight post-finalize, else None."""
     plan = getattr(model, "_pp_plan", None)
     if plan is None or PP_PARAMS_KEY not in model.params:
         return None
@@ -327,7 +371,7 @@ def _pp_segment(model, plan):
         stage = jax.lax.axis_index("pipe")
         n_p = n_stages    # NOT named P: this module aliases PartitionSpec
         R = x.shape[0]
-        M = max(m for m in range(1, n_p + 1) if R % m == 0)
+        M = microbatch_count(R, n_p)
         rsize = R // M
 
         def local_apply(x_mb, k_mb, v_mb, meta_mb):
