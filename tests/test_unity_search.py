@@ -421,3 +421,67 @@ def test_fusion_rules_never_rematch_fused_nodes():
     pcg2 = PCG.from_model(model2)
     for rule in builtin_rules():
         assert not GraphXfer(rule).find_matches(pcg2)
+
+
+def test_searched_training_bert_and_resnet50_pcgs():
+    """The Unity north star's training half (BASELINE.json "Unity search +
+    training run (BERT + ResNet-50)"): optimize_model over an 8-device
+    mesh on BERT- and ResNet-50-shaped PCGs, searched strategy applied at
+    compile, training steps run, and the searched analytic cost is never
+    worse than the naive data-parallel strategy's. Full-size versions run
+    in __graft_entry__.dryrun_multichip; shapes here are small for CI."""
+    import flexflow_tpu as ff
+    from flexflow_tpu.search import optimize_model
+    from flexflow_tpu.training.optimizer import SGDOptimizer
+
+    def bert(cfg):
+        m = ff.FFModel(cfg)
+        toks = m.create_tensor([cfg.batch_size, 8], ff.DataType.DT_INT32)
+        h = m.embedding(toks, 64, 32)
+        a = m.multihead_attention(h, h, h, embed_dim=32, num_heads=4)
+        h = m.layer_norm(m.add(a, h), axes=[-1])
+        f = m.dense(h, 128, ff.ActiMode.AC_MODE_GELU)
+        h = m.layer_norm(m.add(m.dense(f, 32), h), axes=[-1])
+        m.softmax(m.dense(m.mean(h, dims=[1]), 8))
+        return m, np.random.RandomState(0).randint(
+            0, 64, size=(cfg.batch_size, 8)).astype(np.int32), 8
+
+    def resnet(cfg):
+        m = ff.FFModel(cfg)
+        t = m.create_tensor([cfg.batch_size, 3, 16, 16], ff.DataType.DT_FLOAT)
+        x = m.conv2d(t, 16, 3, 3, 1, 1, 1, 1, ff.ActiMode.AC_MODE_RELU)
+        for c_mid, stride in [(8, 1), (16, 2)]:      # bottleneck blocks
+            y = m.batch_norm(m.conv2d(x, c_mid, 1, 1, stride, stride, 0, 0),
+                             relu=True)
+            y = m.batch_norm(m.conv2d(y, c_mid, 3, 3, 1, 1, 1, 1), relu=True)
+            y = m.batch_norm(m.conv2d(y, 4 * c_mid, 1, 1, 1, 1, 0, 0),
+                             relu=False)
+            sc = m.batch_norm(
+                m.conv2d(x, 4 * c_mid, 1, 1, stride, stride, 0, 0),
+                relu=False)
+            x = m.relu(m.add(y, sc))
+        x = m.flat(m.pool2d(x, x.dims[2], x.dims[3], 1, 1, 0, 0,
+                            ff.PoolType.POOL_AVG))
+        m.softmax(m.dense(x, 10))
+        return m, np.random.RandomState(0).randn(
+            cfg.batch_size, 3, 16, 16).astype(np.float32), 10
+
+    for name, build in [("bert", bert), ("resnet50", resnet)]:
+        cfg = ff.FFConfig(batch_size=16, auto_parallel=True,
+                          tpu_chip="v5e", data_parallelism_degree=4,
+                          tensor_parallelism_degree=2, search_budget=20)
+        model, xs, nclass = build(cfg)
+        cfg.only_data_parallel = True
+        dp_cost = optimize_model(model, chip="v5e", num_devices=8).cost
+        cfg.only_data_parallel = False
+        model.compile(
+            optimizer=SGDOptimizer(model, lr=0.01),
+            loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+        assert model.strategy is not None, name
+        assert model.mesh.devices.size == 8, name
+        assert model.strategy.cost <= dp_cost * 1.001, (
+            name, model.strategy.cost, dp_cost)
+        ys = np.random.RandomState(1).randint(
+            0, nclass, size=(16, 1)).astype(np.int32)
+        losses = [model.train_one_batch([xs], ys) for _ in range(2)]
+        assert np.isfinite(losses).all(), (name, losses)
