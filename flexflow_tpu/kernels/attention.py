@@ -47,31 +47,51 @@ def round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
-def _pick_block_s(S: int) -> int:
-    """Cache-stream block size: the smallest supported tile. Decode is
-    bandwidth-bound and reads ceil(length/BS)*BS keys per slot, so small
-    tiles waste the least on short/ragged lengths; the tile must also be
-    the SAME for every q-width — speculative decoding compares a width-1
-    decode against a width-(d+1) verify of the same positions, and a
-    different softmax block partition would flip near-tie argmaxes
-    (reference CI token-match gate, python_inference_tests.sh:29)."""
-    for bs in (128, 256, 512):
+def _pack_factor(D: int) -> int:
+    """Positions packed per 128-lane cache row. D >= 128 streams one
+    position per row (PACK=1); D=64 packs two consecutive positions per
+    row (PACK=2) so every DMA slice stays lane-full — the kernel then
+    processes each block's even/odd position halves as two online-softmax
+    sub-block updates, with zero-padded q variants and lane-masked v so no
+    in-kernel relayout is ever needed. Unsupported D returns 0."""
+    if D % LANE == 0:
+        return 1
+    if D == 64:
+        return 2
+    return 0
+
+
+def _pick_block_s(S: int, D: int = LANE) -> int:
+    """Cache-stream block size (in POSITIONS): the smallest supported
+    tile. Decode is bandwidth-bound and reads ceil(length/BS)*BS keys per
+    slot, so small tiles waste the least on short/ragged lengths; the tile
+    must also be the SAME for every q-width — speculative decoding
+    compares a width-1 decode against a width-(d+1) verify of the same
+    positions, and a different softmax block partition would flip near-tie
+    argmaxes (reference CI token-match gate,
+    python_inference_tests.sh:29). Packed head dims (PACK=2) need 128
+    PACKED rows per block so the [Q, S/PACK] bias slices stay
+    lane-aligned, hence the 256-position floor."""
+    pack = _pack_factor(D)
+    if pack == 0:
+        return 0
+    for bs in (128 * pack, 256 * pack, 512 * pack):
         if S % bs == 0:
             return bs
     return 0  # caller falls back to the jnp path
 
 
-def supports_seq_len(S: int) -> bool:
+def supports_seq_len(S: int, D: int = LANE) -> bool:
     """True iff the Pallas kernels here can tile a cache of length S."""
-    return _pick_block_s(S) > 0
+    return _pick_block_s(S, D) > 0
 
 
 def supports_shapes(S: int, D: int) -> bool:
-    """Single source of truth for dispatch guards in ops/ — Mosaic requires
-    the trailing (lane) dim of a DMA slice to be 128-aligned, so the flash
-    kernels need head_dim % 128 == 0 in addition to a tileable cache
-    length. Callers fall back to the jnp path otherwise."""
-    return supports_seq_len(S) and D % 128 == 0
+    """Single source of truth for dispatch guards in ops/ — Mosaic
+    requires DMA slices lane-full, so head_dim must be 128-aligned or a
+    supported packed size (64), with a cache length the packed block size
+    tiles. Callers fall back to the jnp path otherwise."""
+    return _pack_factor(D) > 0 and supports_seq_len(S, D)
 
 
 def _kernel(len_ref,                       # scalar prefetch: [R] int32
@@ -79,12 +99,12 @@ def _kernel(len_ref,                       # scalar prefetch: [R] int32
             o_ref,
             acc, m, l, kbuf, vbuf, bbuf, sem,
             *, BS: int, causal: bool, has_bias: bool, has_alibi: bool,
-            qk_scale: float, G: int, Q: int, layer_idx):
+            qk_scale: float, G: int, Q: int, layer_idx, PACK: int, D: int):
     _stream_attend(len_ref, None, q_ref, qp_ref, slopes_ref, None, None,
                    bias_hbm, k_hbm, v_hbm, o_ref, acc, m, l, kbuf, vbuf,
                    bbuf, sem, None, BS=BS, causal=causal, has_bias=has_bias,
                    has_alibi=has_alibi, qk_scale=qk_scale, G=G, Q=Q,
-                   layer_idx=layer_idx)
+                   layer_idx=layer_idx, PACK=PACK, D=D)
 
 
 def _append_kernel(len_ref, appos_ref,     # scalar prefetch: [R] int32 each
@@ -94,24 +114,25 @@ def _append_kernel(len_ref, appos_ref,     # scalar prefetch: [R] int32 each
                    acc, m, l, kbuf, vbuf, bbuf, sem, asem,
                    *, BS: int, causal: bool, has_bias: bool,
                    has_alibi: bool, qk_scale: float, G: int, Q: int,
-                   layer_idx):
+                   layer_idx, PACK: int, D: int):
     """Decode-step variant: this step's single new token's K/V rows land at
     cache position ``appos[r]`` IN PLACE (the caches are aliased in/out),
     fused with the attention stream — replacing the XLA Q=1 row scatter
     that cost ~1.6 ms/step at 7B geometry (R*KH*L = 16K scalar-unit rows).
     The new rows are merged into the streamed VMEM block (so attention
     sees the post-append cache with zero extra latency) and the aligned
-    8-row window containing p is written back asynchronously (Mosaic DMA
-    slices of [.., S, D] need SUBLANE-aligned S): rows [pb, p) re-land
-    bitwise-identical, row p gets the new K/V, rows (p, pb+8) re-land
-    whatever garbage they held (beyond ``length``, never attended).
-    Write-backs touch only row r's slice, so they never race the
-    cross-program prefetch of other rows."""
+    8-packed-row window containing p is written back asynchronously
+    (Mosaic DMA slices need SUBLANE-aligned second-minor dims): rows
+    [pb, p) re-land bitwise-identical, row p gets the new K/V, rows
+    beyond re-land whatever garbage they held (past ``length``, never
+    attended). Write-backs touch only row r's slice, so they never race
+    the cross-program prefetch of other rows."""
     _stream_attend(len_ref, appos_ref, q_ref, qp_ref, slopes_ref, knew_ref,
                    vnew_ref, bias_hbm, ok_hbm, ov_hbm, o_ref, acc, m, l,
                    kbuf, vbuf, bbuf, sem, asem, BS=BS, causal=causal,
                    has_bias=has_bias, has_alibi=has_alibi,
-                   qk_scale=qk_scale, G=G, Q=Q, layer_idx=layer_idx)
+                   qk_scale=qk_scale, G=G, Q=Q, layer_idx=layer_idx,
+                   PACK=PACK, D=D)
 
 
 def _stream_attend(len_ref, appos_ref, q_ref, qp_ref, slopes_ref, knew_ref,
@@ -119,11 +140,23 @@ def _stream_attend(len_ref, appos_ref, q_ref, qp_ref, slopes_ref, knew_ref,
                    acc, m, l, kbuf, vbuf, bbuf, sem, asem,
                    *, BS: int, causal: bool, has_bias: bool,
                    has_alibi: bool, qk_scale: float, G: int, Q: int,
-                   layer_idx):
+                   layer_idx, PACK: int, D: int):
+    """Shared stream-attend body.
+
+    PACK == 1: one position per 128-lane cache row (D % 128 == 0).
+    PACK == 2 (D == 64): two consecutive positions per row; each block's
+    even/odd halves are processed as two online-softmax sub-block updates.
+    The caller pre-builds PACK zero-padded q variants (q in lanes
+    [h*D, (h+1)*D), zeros elsewhere) so the half-dot needs no lane
+    slicing, v is lane-masked with a select, and the [KH, GQ, LANE]
+    accumulator's halves are summed OUTSIDE the kernel — no in-kernel
+    relayout anywhere.
+    """
     has_append = appos_ref is not None
     r = pl.program_id(0)
     R = len_ref.shape[0]
     length = len_ref[r]
+    SB = BS // PACK                       # packed rows per block
 
     def nb_of(j):
         return (len_ref[j] + jnp.asarray(BS - 1, jnp.int32)) // BS
@@ -133,9 +166,9 @@ def _stream_attend(len_ref, appos_ref, q_ref, qp_ref, slopes_ref, knew_ref,
     m[:] = jnp.full_like(m, NEG_INF)
     l[:] = jnp.zeros_like(l)
 
-    # stacked-cache mode: k/v are the whole [L, R, KH, S, D] buffers and
-    # this call streams only layer ``layer_idx`` — the caller never has to
-    # materialize a per-layer slice in HBM
+    # stacked-cache mode: k/v are the whole [L, R, KH, S/PACK, LANE]
+    # buffers and this call streams only layer ``layer_idx`` — the caller
+    # never has to materialize a per-layer slice in HBM
     if layer_idx is not None:
         k_hbm = k_hbm.at[layer_idx]
         v_hbm = v_hbm.at[layer_idx]
@@ -159,15 +192,18 @@ def _stream_attend(len_ref, appos_ref, q_ref, qp_ref, slopes_ref, knew_ref,
 
     def dmas(row, slot, i):
         yield pltpu.make_async_copy(
-            k_hbm.at[row, :, pl.ds(i * BS, BS)], kbuf.at[slot],
+            k_hbm.at[row, :, pl.ds(i * SB, SB)], kbuf.at[slot],
             sem.at[slot, 0])
         yield pltpu.make_async_copy(
-            v_hbm.at[row, :, pl.ds(i * BS, BS)], vbuf.at[slot],
+            v_hbm.at[row, :, pl.ds(i * SB, SB)], vbuf.at[slot],
             sem.at[slot, 1])
         if has_bias:
-            yield pltpu.make_async_copy(
-                bias_hbm.at[row, :, pl.ds(i * BS, BS)], bbuf.at[slot],
-                sem.at[slot, 2])
+            if PACK == 1:
+                b_src = bias_hbm.at[row, :, pl.ds(i * BS, BS)]
+            else:       # de-interleaved [R, PACK, Q, S/PACK] (see caller)
+                b_src = bias_hbm.at[row, :, :, pl.ds(i * SB, SB)]
+            yield pltpu.make_async_copy(b_src, bbuf.at[slot],
+                                        sem.at[slot, 2])
 
     def start_dmas(row, slot, i):
         for d in dmas(row, slot, i):
@@ -181,12 +217,12 @@ def _stream_attend(len_ref, appos_ref, q_ref, qp_ref, slopes_ref, knew_ref,
     def _():                              # first live program self-starts
         start_dmas(r, g0 % 2, 0)
 
-    qt = q_ref[0]                                   # [KH, GQ, D]
-    GQ = qt.shape[1]
+    GQ = q_ref.shape[-2]
     qp = qp_ref[r]                                  # [GQ] absolute positions
     if has_append:
         p_app = appos_ref[r]
         bp = p_app // BS                  # block holding the new position
+        pr = p_app // PACK                # its global packed row
 
     def body(i, _):
         slot = (g0 + i) % 2
@@ -206,17 +242,21 @@ def _stream_attend(len_ref, appos_ref, q_ref, qp_ref, slopes_ref, knew_ref,
             def _():
                 # merge the new K/V row into the streamed block in VMEM
                 # (bitwise-identical to appending before the stream), and
-                # write back the aligned 8-row window it lives in
-                KH, D = kbuf.shape[1], kbuf.shape[3]
-                pm = p_app - bp * BS
-                sel = jax.lax.broadcasted_iota(
-                    jnp.int32, (KH, BS, D), 1) == pm
+                # write back the aligned 8-packed-row window it lives in
+                KH = kbuf.shape[1]
+                pm_row = pr - bp * SB     # packed row within the block
+                hm = p_app - pr * PACK    # lane half within the row
+                sub = jax.lax.broadcasted_iota(
+                    jnp.int32, (KH, SB, LANE if PACK > 1 else D), 1)
+                lane = jax.lax.broadcasted_iota(
+                    jnp.int32, (KH, SB, LANE if PACK > 1 else D), 2)
+                sel = (sub == pm_row) & (lane // D == hm)
                 kbuf[slot] = jnp.where(sel, knew_ref[0, 0][:, None, :],
                                        kbuf[slot])
                 vbuf[slot] = jnp.where(sel, vnew_ref[0, 0][:, None, :],
                                        vbuf[slot])
-                wo = (pm // SUBLANE) * SUBLANE
-                pb_abs = (p_app // SUBLANE) * SUBLANE
+                wo = (pm_row // SUBLANE) * SUBLANE
+                pb_abs = (pr // SUBLANE) * SUBLANE
                 wk = pltpu.make_async_copy(
                     kbuf.at[slot, :, pl.ds(wo, SUBLANE)],
                     k_hbm.at[r, :, pl.ds(pb_abs, SUBLANE)], asem.at[0])
@@ -225,48 +265,62 @@ def _stream_attend(len_ref, appos_ref, q_ref, qp_ref, slopes_ref, knew_ref,
                     v_hbm.at[r, :, pl.ds(pb_abs, SUBLANE)], asem.at[1])
                 wk.start()
                 wv.start()
-        k = kbuf[slot]                              # [KH, BS, D]
+        k = kbuf[slot]                    # [KH, SB, D or LANE]
         v = vbuf[slot]
-        # scores[kh, gq, s] = q[kh, gq, :] . k[kh, s, :]
-        s = jax.lax.dot_general(
-            qt.astype(k.dtype), k,
-            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)     # [KH, GQ, BS]
-        s = s * qk_scale
-        s_ids = i * BS + jax.lax.broadcasted_iota(jnp.int32, (GQ, BS), 1)
-        if has_alibi:
-            dist = (qp[:, None] - s_ids).astype(jnp.float32)
-            s = s - slopes_ref[:, :][:, :, None] * dist[None]
-        if has_bias:
-            b = bbuf[slot]                          # [Q, BS]
-            s = s + jnp.tile(b, (G, 1))[None]       # row g*Q+q <- b[q]
-        if causal:
-            visible = s_ids <= qp[:, None]
-        else:
-            visible = jnp.ones((GQ, BS), dtype=bool)
-        visible = visible & (s_ids < length)
-        s = jnp.where(visible[None], s, NEG_INF)
+        for h in range(PACK):             # even/odd position halves
+            qt_h = q_ref[0] if PACK == 1 else q_ref[0, h]
+            # scores[kh, gq, s] = q[kh, gq, :] . k[kh, s, :] — for packed
+            # halves q is zero outside lanes [h*D, (h+1)*D), so the full
+            # 128-lane contraction IS the half-dot
+            s = jax.lax.dot_general(
+                qt_h.astype(k.dtype), k,
+                dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)     # [KH, GQ, SB]
+            s = s * qk_scale
+            s_ids = (i * BS + h
+                     + PACK * jax.lax.broadcasted_iota(jnp.int32, (GQ, SB),
+                                                       1))
+            if has_alibi:
+                dist = (qp[:, None] - s_ids).astype(jnp.float32)
+                s = s - slopes_ref[:, :][:, :, None] * dist[None]
+            if has_bias:
+                b = bbuf[slot] if PACK == 1 else bbuf[slot, h]  # [Q, SB]
+                s = s + jnp.tile(b, (G, 1))[None]   # row g*Q+q <- b[q]
+            if causal:
+                visible = s_ids <= qp[:, None]
+            else:
+                visible = jnp.ones((GQ, SB), dtype=bool)
+            visible = visible & (s_ids < length)
+            s = jnp.where(visible[None], s, NEG_INF)
 
-        m_new = jnp.maximum(m[:], jnp.max(s, axis=-1, keepdims=True))
-        corr = jnp.exp(m[:] - m_new)
-        p = jnp.exp(s - m_new)                      # [KH, GQ, BS] f32
-        l[:] = l[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v,
-            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)     # [KH, GQ, D]
-        acc[:] = acc[:] * corr + pv
-        m[:] = m_new
+            m_new = jnp.maximum(m[:], jnp.max(s, axis=-1, keepdims=True))
+            corr = jnp.exp(m[:] - m_new)
+            p = jnp.exp(s - m_new)                  # [KH, GQ, SB] f32
+            l[:] = l[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+            if PACK == 1:
+                v_h = v
+            else:
+                # other half's lanes zeroed so the contraction only picks
+                # up this half's values (their halves' accumulator lanes
+                # are summed outside the kernel)
+                lane = jax.lax.broadcasted_iota(
+                    jnp.int32, v.shape, v.ndim - 1)
+                v_h = jnp.where(lane // D == h, v, jnp.zeros_like(v))
+            pv = jax.lax.dot_general(
+                p.astype(v.dtype), v_h,
+                dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)  # [KH, GQ, D|LANE]
+            acc[:] = acc[:] * corr + pv
+            m[:] = m_new
         if has_append:
             @pl.when(i == bp)
             def _():
                 # the write-back must land before this program ends (the
                 # buffer slot is reused two global blocks later, and the
                 # next layer's kernel reads the region through the alias)
-                KH, D = kbuf.shape[1], kbuf.shape[3]
-                pm = p_app - bp * BS
-                wo = (pm // SUBLANE) * SUBLANE
-                pb_abs = (p_app // SUBLANE) * SUBLANE
+                pm_row = pr - bp * SB
+                wo = (pm_row // SUBLANE) * SUBLANE
+                pb_abs = (pr // SUBLANE) * SUBLANE
                 pltpu.make_async_copy(
                     kbuf.at[slot, :, pl.ds(wo, SUBLANE)],
                     k_hbm.at[r, :, pl.ds(pb_abs, SUBLANE)],
@@ -311,8 +365,11 @@ def flash_attend(q, k_cache, v_cache, lengths, qpos, bias=None,
     KH, S = k_cache.shape[-3], k_cache.shape[-2]
     G = H // KH
     GQ = G * Q
-    BS = _pick_block_s(S)
-    assert BS > 0, f"S={S} not divisible by a supported block size"
+    PACK = _pack_factor(D)
+    BS = _pick_block_s(S, D)
+    assert BS > 0, f"S={S}/D={D} not tileable by a supported block size"
+    SB = BS // PACK
+    DL = D if PACK == 1 else LANE         # kernel-side lane width
     if qk_scale is None:
         qk_scale = 1.0 / math.sqrt(D)
     out_dtype = out_dtype or q.dtype
@@ -320,6 +377,17 @@ def flash_attend(q, k_cache, v_cache, lengths, qpos, bias=None,
     # [R, Q, H, D] -> [R, KH, G*Q, D], row index g*Q + q
     qt = q.reshape(R, Q, KH, G, D).transpose(0, 2, 3, 1, 4).reshape(
         R, KH, GQ, D)
+    if PACK > 1:
+        # PACK zero-padded variants: variant h holds q in lanes
+        # [h*D, (h+1)*D) and zeros elsewhere, so the kernel's full-lane
+        # contraction against a packed cache row IS the half-dot
+        qt = jnp.stack(
+            [jnp.pad(qt, ((0, 0),) * 3 + ((h * D, LANE - (h + 1) * D),))
+             for h in range(PACK)], axis=1)         # [R, PACK, KH, GQ, LANE]
+        # packed cache view: [.., S, D] -> [.., S/PACK, LANE] (row-major
+        # bitcast: row j holds positions PACK*j .. PACK*j+PACK-1)
+        k_cache = k_cache.reshape(k_cache.shape[:-2] + (S // PACK, LANE))
+        v_cache = v_cache.reshape(v_cache.shape[:-2] + (S // PACK, LANE))
     qp_gq = jnp.tile(qpos.astype(jnp.int32), (1, G))            # [R, GQ]
     has_bias = bias is not None
     has_alibi = alibi is not None
@@ -328,29 +396,35 @@ def flash_attend(q, k_cache, v_cache, lengths, qpos, bias=None,
             alibi.astype(jnp.float32).reshape(KH, G), Q, axis=1)  # [KH, GQ]
     else:
         slopes_gq = jnp.zeros((KH, GQ), jnp.float32)
+    if has_bias and PACK > 1:
+        # de-interleave so half h's [Q, SB] block is a contiguous slice
+        bias = bias.reshape(R, Q, S // PACK, PACK).transpose(0, 3, 1, 2)
     if not has_bias:
         # Minimal placeholder to fill the operand slot; the kernel only
         # DMAs bias when has_bias=True, so no [R, 1, S] HBM buffer needed.
-        bias = jnp.zeros((1, 1, 1), jnp.float32)
+        bias = jnp.zeros((1, 1, 1, 1) if PACK > 1 else (1, 1, 1),
+                         jnp.float32)
 
     # Clamp: an out-of-range length would DMA past the cache end.
     lengths = jnp.minimum(lengths.astype(jnp.int32), S)
 
     cache_dt = k_cache.dtype
-    kv_bytes = 2 * 2 * BS * KH * D * cache_dt.itemsize
+    kv_bytes = 2 * 2 * SB * KH * DL * cache_dt.itemsize
     compiler_params = pltpu.CompilerParams(
         vmem_limit_bytes=int(min(
             128 * 1024 * 1024,
-            8 * (KH * GQ * (D + 2) * 4 + KH * GQ * D * 2
-                 + kv_bytes + 2 * Q * BS * 4) + 1024 * 1024)),
+            8 * (KH * GQ * (DL + 2) * 4 + PACK * KH * GQ * DL * 2
+                 + kv_bytes + 2 * PACK * Q * SB * 4) + 1024 * 1024)),
     )
     cost_estimate = pl.CostEstimate(
         flops=4 * R * GQ * KH * D * S,
         bytes_accessed=2 * R * S * KH * D * cache_dt.itemsize,
         transcendentals=R * KH * GQ * S,
     )
+    q_block = ((1, KH, GQ, D) if PACK == 1
+               else (1, PACK, KH, GQ, LANE))
     qkv_in_specs = [
-        pl.BlockSpec((1, KH, GQ, D), lambda r, *_: (r, 0, 0, 0),
+        pl.BlockSpec(q_block, lambda r, *_: (r,) + (0,) * (len(q_block) - 1),
                      memory_space=pltpu.VMEM),                   # qt
         pl.BlockSpec(memory_space=pltpu.VMEM),                   # qp [R, GQ]
         pl.BlockSpec((KH, GQ), lambda r, *_: (0, 0),
@@ -361,46 +435,62 @@ def flash_attend(q, k_cache, v_cache, lengths, qpos, bias=None,
         pl.BlockSpec(memory_space=pl.ANY),                       # k cache
         pl.BlockSpec(memory_space=pl.ANY),                       # v cache
     ]
-    o_spec = pl.BlockSpec((1, KH, GQ, D), lambda r, *_: (r, 0, 0, 0),
+    o_spec = pl.BlockSpec((1, KH, GQ, DL), lambda r, *_: (r, 0, 0, 0),
                           memory_space=pltpu.VMEM)
+    bias_buf_shape = (2, Q, BS) if PACK == 1 else (2, PACK, Q, SB)
     scratch = [
-        pltpu.VMEM((KH, GQ, D), jnp.float32),                    # acc
+        pltpu.VMEM((KH, GQ, DL), jnp.float32),                   # acc
         pltpu.VMEM((KH, GQ, 1), jnp.float32),                    # m
         pltpu.VMEM((KH, GQ, 1), jnp.float32),                    # l
-        pltpu.VMEM((2, KH, BS, D), cache_dt),                    # k buf
-        pltpu.VMEM((2, KH, BS, D), cache_dt),                    # v buf
-        pltpu.VMEM((2, Q, BS), jnp.float32),                     # bias buf
+        pltpu.VMEM((2, KH, SB, DL), cache_dt),                   # k buf
+        pltpu.VMEM((2, KH, SB, DL), cache_dt),                   # v buf
+        pltpu.VMEM(bias_buf_shape, jnp.float32),                 # bias buf
         pltpu.SemaphoreType.DMA((2, 3)),
     ]
+
+    def post(out):
+        if PACK > 1:
+            # sum the per-half accumulator lanes back to D
+            out = out.reshape(R, KH, GQ, PACK, D).sum(axis=3,
+                                                      dtype=jnp.float32)
+            out = out.astype(out_dtype)
+        # [R, KH, G*Q, D] -> [R, Q, H*D] with h = kh*G + g
+        return out.reshape(R, KH, G, Q, D).transpose(0, 3, 1, 2, 4).reshape(
+            R, Q, H * D)
 
     if append_kv is None:
         kern = functools.partial(
             _kernel, BS=BS, causal=causal, has_bias=has_bias,
             has_alibi=has_alibi, qk_scale=float(qk_scale), G=G, Q=Q,
-            layer_idx=layer_idx)
+            layer_idx=layer_idx, PACK=PACK, D=D)
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1, grid=(R,),
             in_specs=qkv_in_specs + tail_in_specs,
             out_specs=o_spec, scratch_shapes=scratch)
         out = pl.pallas_call(
             kern, grid_spec=grid_spec,
-            out_shape=jax.ShapeDtypeStruct((R, KH, GQ, D), out_dtype),
+            out_shape=jax.ShapeDtypeStruct(
+                (R, KH, GQ, DL),
+                jnp.float32 if PACK > 1 else out_dtype),
             compiler_params=compiler_params, cost_estimate=cost_estimate,
             interpret=interpret,
         )(lengths.astype(jnp.int32), qt, qp_gq, slopes_gq,
           bias.astype(jnp.float32), k_cache, v_cache)
-        # [R, KH, G*Q, D] -> [R, Q, H*D] with h = kh*G + g
-        return out.reshape(R, KH, G, Q, D).transpose(0, 3, 1, 2, 4).reshape(
-            R, Q, H * D)
+        return post(out)
 
     # fused decode append: write (k_new, v_new) at appos[r] in place, then
     # attend; the caches alias through to the outputs (donation-safe)
     k_new, v_new, appos = append_kv
+    if PACK > 1:
+        # the kernel's merge select places the row in lane half p % PACK;
+        # tiling the D lanes PACK times gives it the value in every half
+        k_new = jnp.concatenate([k_new] * PACK, axis=-1)
+        v_new = jnp.concatenate([v_new] * PACK, axis=-1)
     kern = functools.partial(
         _append_kernel, BS=BS, causal=causal, has_bias=has_bias,
         has_alibi=has_alibi, qk_scale=float(qk_scale), G=G, Q=Q,
-        layer_idx=layer_idx)
-    knew_spec = pl.BlockSpec((1, 1, KH, D), lambda r, *_: (r, 0, 0, 0),
+        layer_idx=layer_idx, PACK=PACK, D=D)
+    knew_spec = pl.BlockSpec((1, 1, KH, DL), lambda r, *_: (r, 0, 0, 0),
                              memory_space=pltpu.VMEM)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2, grid=(R,),
@@ -410,7 +500,8 @@ def flash_attend(q, k_cache, v_cache, lengths, qpos, bias=None,
         scratch_shapes=scratch + [pltpu.SemaphoreType.DMA((2,))])
     out, k_out, v_out = pl.pallas_call(
         kern, grid_spec=grid_spec,
-        out_shape=(jax.ShapeDtypeStruct((R, KH, GQ, D), out_dtype),
+        out_shape=(jax.ShapeDtypeStruct(
+            (R, KH, GQ, DL), jnp.float32 if PACK > 1 else out_dtype),
                    jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
                    jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype)),
         input_output_aliases={8: 1, 9: 2},   # k/v cache operands -> outputs
@@ -419,9 +510,11 @@ def flash_attend(q, k_cache, v_cache, lengths, qpos, bias=None,
     )(lengths.astype(jnp.int32), appos.astype(jnp.int32), qt, qp_gq,
       slopes_gq, k_new.astype(cache_dt), v_new.astype(cache_dt),
       bias.astype(jnp.float32), k_cache, v_cache)
-    out = out.reshape(R, KH, G, Q, D).transpose(0, 3, 1, 2, 4).reshape(
-        R, Q, H * D)
-    return out, k_out, v_out
+    if PACK > 1:
+        # un-pack the cache views back to the caller's [.., S, D] shape
+        k_out = k_out.reshape(k_out.shape[:-2] + (S, D))
+        v_out = v_out.reshape(v_out.shape[:-2] + (S, D))
+    return post(out), k_out, v_out
 
 
 def reference_attend(q, k_cache, v_cache, lengths, qpos, bias=None,

@@ -42,7 +42,7 @@ def test_flash_decode_matches_reference(dtype, tol):
 
 
 def test_flash_prefill_causal():
-    R, Q, H, KH, D, S = 3, 32, 8, 8, 64, 128
+    R, Q, H, KH, D, S = 3, 32, 8, 8, 64, 256
     q, k, v = _mk(R, Q, H, KH, D, S)
     lengths = jnp.asarray([32, 7, 20], jnp.int32)
     qpos = jnp.tile(jnp.arange(Q, dtype=jnp.int32)[None], (R, 1))
@@ -80,7 +80,7 @@ def test_flash_gqa_groups():
 
 
 def test_flash_lengths_clamped_to_cache():
-    R, Q, H, KH, D, S = 2, 1, 4, 4, 64, 128
+    R, Q, H, KH, D, S = 2, 1, 4, 4, 64, 256
     q, k, v = _mk(R, Q, H, KH, D, S, seed=9)
     lengths = jnp.asarray([S + 64, S], jnp.int32)   # overshoot clamps to S
     qpos = jnp.asarray([[S - 1], [S - 1]], jnp.int32)
@@ -96,7 +96,7 @@ def test_serving_attention_op_uses_same_semantics():
 
     from flexflow_tpu.ops.inc_attention import append_kv
 
-    R, Q, H, KH, D, S = 2, 1, 8, 4, 64, 128
+    R, Q, H, KH, D, S = 2, 1, 8, 4, 64, 256
     rng = np.random.RandomState(11)
     k_cache = jnp.zeros((R, KH, S, D), jnp.float32)
     v_cache = jnp.zeros((R, KH, S, D), jnp.float32)
@@ -120,9 +120,10 @@ def test_serving_attention_op_uses_same_semantics():
 
 def test_head_dim_64_takes_flash_path_and_matches_jnp(monkeypatch):
     """D=64-class models (GPT-2/StarCoder geometry) must keep the flash
-    path: the KV cache pads head_dim to the 128-lane tile (r1 VERDICT —
-    they previously fell back silently and paid O(max_seq) per step).
-    Numerics must match the jnp path token-for-token."""
+    path WITHOUT cache padding (r2 VERDICT: the former pad-to-128 cost 2x
+    KV memory and bandwidth forever) — the kernel packs two positions per
+    128-lane cache row instead. Numerics must match the jnp path
+    token-for-token."""
     import flexflow_tpu as ff
     import flexflow_tpu.kernels as ffk
     from flexflow_tpu.ffconst import InferenceMode
@@ -131,29 +132,90 @@ def test_head_dim_64_takes_flash_path_and_matches_jnp(monkeypatch):
 
     tiny = LLAMAConfig(vocab_size=128, hidden_size=256, intermediate_size=256,
                        num_hidden_layers=2, num_attention_heads=4,
-                       num_key_value_heads=2, max_position_embeddings=128)
+                       num_key_value_heads=2, max_position_embeddings=256)
 
-    def gen(expect_cache_d):
-        cfg = ff.FFConfig(max_requests_per_batch=2, max_sequence_length=128,
+    def gen():
+        cfg = ff.FFConfig(max_requests_per_batch=2, max_sequence_length=256,
                           max_tokens_per_batch=16, seed=0,
                           kv_cache_dtype="float32")
         m = ff.FFModel(cfg)
         create_llama_model(m, tiny, mode=InferenceMode.INC_DECODING_MODE)
         m.compile(comp_mode=ff.CompMode.COMP_MODE_INFERENCE)
-        # pad-to-lane-tile applies only when the flash path can engage;
-        # jnp-only configs keep the exact head_dim (no wasted KV memory)
-        assert m.op_state["kv_cache"]["k"].shape[-1] == expect_cache_d
+        # the packed flash path needs NO head-dim padding: cache stays D=64
+        assert m.op_state["kv_cache"]["k"].shape[-1] == 64
         rm = RequestManager()
         rm.register_new_request([5, 9, 23], max_new_tokens=6)
         return [r.output_tokens for r in rm.generate_incr_decoding(m)]
 
-    base = gen(64)                                 # jnp path (CPU)
+    base = gen()                                   # jnp path (CPU)
     monkeypatch.setenv("FF_PALLAS_INTERPRET", "1")  # force Pallas kernels
     ffk.reset_dispatch_stats()
-    flash = gen(128)
+    flash = gen()
     assert ffk.fast_path_count > 0, "flash path never engaged"
     assert not ffk.fallback_counts, ffk.fallback_counts
     assert base == flash
+
+
+def test_flash_packed_d64_matches_reference():
+    """The packed D=64 kernel (two positions per 128-lane row, even/odd
+    half sub-blocks) must match the jnp oracle for decode, prefill, bias,
+    GQA, and the fused append."""
+    R, H, KH, D, S = 4, 8, 4, 64, 512
+    for Q, seed in [(1, 0), (8, 1), (16, 2)]:
+        q, k, v = _mk(R, Q, H, KH, D, S, seed=seed)
+        lengths = jnp.asarray([37, 1, 512, 255], jnp.int32)
+        qpos = ((lengths - Q).clip(0)[:, None]
+                + jnp.arange(Q, dtype=jnp.int32)[None])
+        ref = reference_attend(q, k, v, lengths, qpos)
+        out = flash_attend(q, k, v, lengths, qpos, interpret=True)
+        _cmp(ref, out, lengths, 2e-5)
+    # tree bias path
+    Q = 8
+    q, k, v = _mk(R, Q, H, KH, D, S, seed=5)
+    rng = np.random.RandomState(9)
+    bias = jnp.asarray(
+        np.where(rng.rand(R, Q, S) < 0.3, NEG_INF, 0.0).astype(np.float32))
+    lengths = jnp.asarray([100, 60, 512, 8], jnp.int32)
+    qpos = (lengths - 1).clip(0)[:, None] + jnp.arange(Q, dtype=jnp.int32)[None]
+    ref = reference_attend(q, k, v, lengths, qpos, bias=bias, causal=False)
+    out = flash_attend(q, k, v, lengths, qpos, bias=bias, causal=False,
+                       interpret=True)
+    _cmp(ref, out, lengths, 2e-5)
+    # fused append at D=64 (packed row merge + window write-back)
+    k_new = jnp.asarray(rng.randn(R, 1, KH, D).astype(np.float32))
+    v_new = jnp.asarray(rng.randn(R, 1, KH, D).astype(np.float32))
+    appos = jnp.asarray([36, 0, 511, -1], jnp.int32)
+    lengths = jnp.asarray([37, 1, 512, 0], jnp.int32)
+    qpos = (appos.clip(0)[:, None] + jnp.arange(Q, dtype=jnp.int32)[None])
+    rows = jnp.arange(R)
+    valid = appos >= 0
+    cols = jnp.where(valid, appos, S)
+    k_ref = k.at[rows, :, cols.clip(0, S)].set(
+        jnp.where(valid[:, None, None], k_new[:, 0], k[rows, :, cols % S]),
+        mode="drop")
+    v_ref = v.at[rows, :, cols.clip(0, S)].set(
+        jnp.where(valid[:, None, None], v_new[:, 0], v[rows, :, cols % S]),
+        mode="drop")
+    ref = reference_attend(q, k_ref, v_ref, lengths, qpos)
+    out, k_out, v_out = flash_attend(
+        q, k, v, lengths, qpos, append_kv=(k_new, v_new, appos),
+        interpret=True)
+    _cmp(ref, out, lengths, 2e-5)
+    k_out = np.asarray(k_out)
+    assert k_out.shape == (R, KH, S, D)
+    for r in range(R):
+        p = int(appos[r])
+        if p >= 0:
+            np.testing.assert_array_equal(k_out[r, :, p], k_new[r, 0])
+            # outside the 8-packed-row (16-position) aligned window the
+            # cache is bitwise preserved
+            pb = (p // 2 // 8) * 8 * 2
+            keep = np.ones(S, bool)
+            keep[pb:pb + 16] = False
+            np.testing.assert_array_equal(k_out[r][:, keep],
+                                          np.asarray(k)[r][:, keep])
+        else:
+            np.testing.assert_array_equal(k_out[r], np.asarray(k)[r])
 
 
 def test_fallback_is_recorded_and_warned(monkeypatch):
@@ -256,3 +318,33 @@ def test_flash_fused_append_stacked_layer():
     for r in range(R):
         np.testing.assert_array_equal(k_out[1, r, :, int(appos[r])],
                                       k_new[r, 0])
+
+
+def test_head_dim_64_short_cache_pads_to_keep_flash(monkeypatch):
+    """D=64 with a cache length the packed 256-position block can't tile
+    (S=128) must fall back to the pad-to-128 cache layout — NOT off the
+    flash path entirely (BS=128 tiles the padded cache)."""
+    import flexflow_tpu as ff
+    import flexflow_tpu.kernels as ffk
+    from flexflow_tpu.ffconst import InferenceMode
+    from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+    from flexflow_tpu.serve.request_manager import RequestManager
+
+    tiny = LLAMAConfig(vocab_size=128, hidden_size=256, intermediate_size=256,
+                       num_hidden_layers=2, num_attention_heads=4,
+                       num_key_value_heads=2, max_position_embeddings=128)
+    monkeypatch.setenv("FF_PALLAS_INTERPRET", "1")
+    ffk.reset_dispatch_stats()
+    cfg = ff.FFConfig(max_requests_per_batch=2, max_sequence_length=128,
+                      max_tokens_per_batch=16, seed=0,
+                      kv_cache_dtype="float32")
+    m = ff.FFModel(cfg)
+    create_llama_model(m, tiny, mode=InferenceMode.INC_DECODING_MODE)
+    m.compile(comp_mode=ff.CompMode.COMP_MODE_INFERENCE)
+    assert m.op_state["kv_cache"]["k"].shape[-1] == 128   # padded layout
+    rm = RequestManager()
+    rm.register_new_request([5, 9, 23], max_new_tokens=6)
+    (r,) = rm.generate_incr_decoding(m)
+    assert len(r.output_tokens) == 6
+    assert ffk.fast_path_count > 0, "flash path never engaged"
+    assert not ffk.fallback_counts, ffk.fallback_counts
