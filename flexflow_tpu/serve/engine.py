@@ -585,3 +585,281 @@ class SpecChainEngine:
             jnp.asarray(remaining, dtype=jnp.int32))
         packed = np.asarray(packed)
         return packed[:, :, :-1], packed[:, :, -1]
+
+
+class BeamSpecEngine:
+    """Fused beam-width>1 single-SSM speculation: one device call per
+    block of rounds (reference BeamSearchBatchConfig beam expansion +
+    BeamTopK parent tracking + per-beam KV,
+    spec_inc_multihead_self_attention.cu — the host-stepped twin is
+    RequestManager._draft_beams / _generate_spec_tree_host).
+
+    TPU-first: the NODE LAYOUT is compile-time static — node 0 is the
+    root, beam step t's W selected children occupy indices
+    [1 + t*W, 1 + (t+1)*W) — while the parent pointers, ancestor mask,
+    and cumulative log-probs are DYNAMIC data on that static shape. The
+    frontier is always the newest W nodes (static indices), so every
+    beam step is one staged tree forward + a top-W select, all inside
+    the jitted round:
+
+    * catch-up chain pass over last round's accepted block doubles as
+      the root expansion (packed [top-W probs, top-W ids] output at the
+      block's last real token);
+    * beam steps re-stage the accumulated tree (tree attention gives
+      every frontier node its ancestor-path context — no per-beam KV);
+    * candidates = W frontier x W children; jnp.log(f32) cumulative
+      scores; lax.top_k picks the next level (ties resolve to the lower
+      flattened (frontier, child) index, mirroring the host's stable
+      sort over frontier-major candidate lists);
+    * the LLM verifies the whole tree once; greedy acceptance walks the
+      levels (a child survives iff its parent is on the accepted path
+      and its token equals the verifier's argmax at that parent);
+    * accepted nodes' KV compacts from their staged slots into the
+      committed region (the reference's commit_tokens_kernel).
+    """
+
+    def __init__(self, llm, ssm, depth: int = 4, width: int = 2,
+                 max_rounds: int = 16):
+        self.llm = llm
+        self.ssm = ssm
+        llm.finalize_pipeline()
+        ssm.finalize_pipeline()
+        self.depth = depth
+        self.width = width
+        self.max_rounds = max_rounds
+        self._compute_dtype = jnp.dtype(llm.config.compute_dtype)
+        from flexflow_tpu.kernels.attention import SUBLANE, round_up
+
+        self.T = 1 + depth * width            # real tree nodes
+        self.tree_width = round_up(max(self.T, depth + 1), SUBLANE)
+        # node depth is a static function of the layout
+        nd = np.zeros((self.tree_width,), np.int32)
+        for t in range(depth):
+            nd[1 + t * width: 1 + (t + 1) * width] = t + 1
+        self._depth_of = jnp.asarray(nd)
+        self._block = jax.jit(self._block_impl, donate_argnums=(1, 3))
+        self._rng_const = jax.random.PRNGKey(llm.config.seed)
+
+    def _select(self, cand, ids_flat, par_flat):
+        """top-W over the flattened candidate scores; returns
+        (cum [R,W], tokens [R,W], parents [R,W])."""
+        W = self.width
+        cum, idx = jax.lax.top_k(cand, W)
+        tok = jnp.take_along_axis(ids_flat, idx, axis=1).astype(jnp.int32)
+        par = jnp.take_along_axis(par_flat, idx, axis=1).astype(jnp.int32)
+        return cum, tok, par
+
+    def _round(self, llm_params, llm_state, ssm_params, ssm_state, tks,
+               nblk, base, active, rng):
+        from flexflow_tpu.serve.batch_config import TreeBatchMeta
+
+        d, W, T, Tp = self.depth, self.width, self.T, self.tree_width
+        R = tks.shape[0]
+        r_pos = base + nblk - 1
+
+        # --- catch-up + root expansion (one causal pass, width d+1) ---
+        pos = base[:, None] + jnp.arange(d + 1)[None, :]
+        num = jnp.where(active, nblk, 0)
+        out0, ssm_state = forward_with_meta(
+            self.ssm, ssm_params, ssm_state,
+            BatchMeta(tokens=tks, positions=pos, start_pos=base,
+                      num_tokens=num, active=active),
+            jax.random.fold_in(rng, 0), self._compute_dtype,
+            kv_contiguous=True)                       # [R, d+1, 2W]
+        root_out = jnp.take_along_axis(
+            out0, jnp.maximum(nblk - 1, 0)[:, None, None], axis=1)[:, 0]
+        root = jnp.take_along_axis(
+            tks, jnp.maximum(nblk - 1, 0)[:, None], axis=1)[:, 0]
+
+        tokens = jnp.zeros((R, Tp), jnp.int32).at[:, 0].set(root)
+        parent = jnp.full((R, Tp), -1, jnp.int32)
+        anc = jnp.zeros((R, Tp, Tp), bool)
+        anc = anc.at[:, 0, 0].set(True)
+        positions = r_pos[:, None] + self._depth_of[None, :]
+
+        cum = jnp.zeros((R, W), jnp.float32)
+        for t in range(d):
+            if t == 0:
+                probs = root_out[:, None, :W]          # [R, 1, W]
+                ids = root_out[:, None, W:2 * W]
+                f0 = 0
+                par_of_cand = jnp.zeros((R, W), jnp.int32)
+                cand = jnp.log(jnp.maximum(
+                    probs[:, 0].astype(jnp.float32), 1e-20))
+                ids_flat = ids[:, 0]
+                par_flat = par_of_cand
+            else:
+                meta = TreeBatchMeta(
+                    tokens=tokens, positions=positions, parent=parent,
+                    ancestor=anc, start_pos=r_pos,
+                    num_nodes=jnp.where(active, 1 + t * W, 0)
+                    .astype(jnp.int32), active=active)
+                out, ssm_state = forward_with_meta(
+                    self.ssm, ssm_params, ssm_state, meta,
+                    jax.random.fold_in(rng, 1 + t), self._compute_dtype,
+                    kv_contiguous=True)               # [R, Tp, 2W]
+                f0 = 1 + (t - 1) * W
+                probs = out[:, f0:f0 + W, :W].astype(jnp.float32)
+                ids = out[:, f0:f0 + W, W:2 * W]
+                # candidate (fi, j) -> flat fi*W + j, frontier-major like
+                # the host's stable sort order
+                cand = (cum[:, :, None]
+                        + jnp.log(jnp.maximum(probs, 1e-20))
+                        ).reshape(R, W * W)
+                ids_flat = ids.reshape(R, W * W)
+                par_flat = jnp.broadcast_to(
+                    (f0 + jnp.arange(W))[None, :, None], (R, W, W)
+                ).reshape(R, W * W)
+            cum, tok_new, par_new = self._select(cand, ids_flat, par_flat)
+            lvl0 = 1 + t * W
+            tokens = jax.lax.dynamic_update_slice(tokens, tok_new,
+                                                  (0, lvl0))
+            parent = jax.lax.dynamic_update_slice(parent, par_new,
+                                                  (0, lvl0))
+            # ancestor rows: child's row = parent's row | self
+            par_rows = jnp.take_along_axis(
+                anc, par_new[:, :, None].clip(0), axis=1)   # [R, W, Tp]
+            selfhot = jax.nn.one_hot(lvl0 + jnp.arange(W), Tp,
+                                     dtype=bool)[None]
+            anc = jax.lax.dynamic_update_slice(
+                anc, par_rows | selfhot, (0, lvl0, 0))
+
+        # --- verify the whole tree on the LLM ---
+        meta_v = TreeBatchMeta(
+            tokens=tokens, positions=positions, parent=parent, ancestor=anc,
+            start_pos=r_pos,
+            num_nodes=jnp.where(active, T, 0).astype(jnp.int32),
+            active=active)
+        out_v, llm_state = forward_with_meta(
+            self.llm, llm_params, llm_state, meta_v,
+            jax.random.fold_in(rng, 7), self._compute_dtype,
+            kv_contiguous=True)
+        o = out_v.astype(jnp.int32)                   # [R, Tp]
+
+        # --- greedy acceptance walk over the levels ---
+        cur = jnp.zeros((R,), jnp.int32)
+        alive = active
+        n_acc = jnp.zeros((R,), jnp.int32)
+        path = jnp.zeros((R, d), jnp.int32)
+        for t in range(d):
+            lvl0 = 1 + t * W
+            tok_lvl = jax.lax.dynamic_slice(tokens, (0, lvl0), (R, W))
+            par_lvl = jax.lax.dynamic_slice(parent, (0, lvl0), (R, W))
+            want = jnp.take_along_axis(o, cur[:, None], axis=1)[:, 0]
+            ok = ((par_lvl == cur[:, None]) & (tok_lvl == want[:, None])
+                  & alive[:, None])
+            has = jnp.any(ok, axis=1)
+            nxt = lvl0 + jnp.argmax(ok, axis=1).astype(jnp.int32)
+            path = path.at[:, t].set(jnp.where(has, nxt, 0))
+            cur = jnp.where(has, nxt, cur)
+            n_acc = n_acc + has.astype(jnp.int32)
+            alive = alive & has
+        bonus = jnp.take_along_axis(o, cur[:, None], axis=1)[:, 0]
+
+        # --- KV commit: staged slot r_pos+path[t] -> r_pos+1+t ---
+        llm_state = self._commit(llm_state, path, n_acc, r_pos, active)
+
+        chain = jnp.take_along_axis(tokens, path, axis=1)   # [R, d]
+        blk = jnp.zeros((R, d + 1), jnp.int32)
+        idx = jnp.arange(d + 1)[None, :]
+        blk = jnp.where(idx < n_acc[:, None],
+                        jnp.pad(chain, ((0, 0), (0, 1))), blk)
+        blk = jnp.where(idx == n_acc[:, None], bonus[:, None], blk)
+        return (llm_state, ssm_state, blk, n_acc + 1, r_pos + 1, chain,
+                n_acc, bonus)
+
+    def _commit(self, llm_state, path, n_acc, r_pos, active):
+        """cache[r, :, r_pos+1+i] <- cache[r, :, r_pos+path[r, i]] for
+        i < n_acc, all layers (path holds staged NODE indices)."""
+        d = self.depth
+        st = llm_state["kv_cache"]
+
+        def move(cache):                            # [L, R, KH, S, D]
+            L, R, KH, S, D = cache.shape
+            i = jnp.arange(d)[None, :]
+            src = r_pos[:, None] + path
+            src = jnp.clip(src, 0, S - 1)
+            moved = jnp.take_along_axis(
+                cache, src[None, :, None, :, None], axis=3)  # [L,R,KH,d,D]
+            valid = (i < n_acc[:, None]) & active[:, None]
+            dst = jnp.where(valid, r_pos[:, None] + 1 + i, S)
+            lidx = jnp.broadcast_to(
+                jnp.arange(L)[:, None, None, None], (L, R, KH, d))
+            rows = jnp.broadcast_to(
+                jnp.arange(R)[None, :, None, None], (L, R, KH, d))
+            heads = jnp.broadcast_to(
+                jnp.arange(KH)[None, None, :, None], (L, R, KH, d))
+            dstb = jnp.broadcast_to(dst[None, :, None, :], (L, R, KH, d))
+            return cache.at[lidx, rows, heads, dstb].set(moved, mode="drop")
+
+        return {**llm_state,
+                "kv_cache": {"k": move(st["k"]), "v": move(st["v"])}}
+
+    def _block_impl(self, llm_params, llm_state, ssm_params, ssm_state,
+                    tok, pos, active, n_rounds, remaining):
+        R = tok.shape[0]
+        d = self.depth
+        max_seq = self.llm.config.max_sequence_length
+        Tp = self.tree_width
+        rng0 = jax.random.fold_in(self._rng_const, pos.sum())
+        packed0 = jnp.full((R, self.max_rounds, d + 2), 0, jnp.int32)
+        packed0 = packed0.at[:, :, d + 1].set(-1)
+        tks0 = jnp.zeros((R, d + 1), jnp.int32).at[:, 0].set(tok)
+        nblk0 = jnp.ones((R,), jnp.int32)
+
+        def live_mask(base, nblk, remaining):
+            r_pos = base + nblk - 1
+            return (remaining > 0) & (r_pos + Tp <= max_seq - 1)
+
+        def cond(carry):
+            i, _ls, _ss, _tks, nblk, base, remaining, act, _p = carry
+            return (i < n_rounds) & jnp.any(
+                act & live_mask(base, nblk, remaining))
+
+        def body(carry):
+            (i, llm_state, ssm_state, tks, nblk, base, remaining, act,
+             packed) = carry
+            act_i = act & live_mask(base, nblk, remaining)
+            (llm_state, ssm_state, blk, new_nblk, new_base, chain, n_acc,
+             bonus) = self._round(
+                llm_params, llm_state, ssm_params, ssm_state,
+                tks, nblk, base, act_i, jax.random.fold_in(rng0, i))
+            tks = jnp.where(act_i[:, None], blk, tks)
+            nblk = jnp.where(act_i, new_nblk, nblk)
+            base = jnp.where(act_i, new_base, base)
+            remaining = remaining - jnp.where(act_i, n_acc + 1, 0)
+            # blk already holds [accepted tokens, bonus at index n_acc] —
+            # the SpecChainEngine packed contract (committed tokens are
+            # row[:n_acc + 1]), so one host driver serves both engines
+            row = jnp.concatenate(
+                [blk, jnp.where(act_i, n_acc, -1)[:, None]], axis=1)
+            packed = jax.lax.dynamic_update_slice(
+                packed, row[:, None, :], (0, i, 0))
+            return (i + 1, llm_state, ssm_state, tks, nblk, base,
+                    remaining, act, packed)
+
+        (_, llm_state, ssm_state, _, _, _, _, _, packed) = \
+            jax.lax.while_loop(
+                cond, body,
+                (jnp.int32(0), llm_state, ssm_state, tks0, nblk0, pos,
+                 remaining, active, packed0))
+        return llm_state, ssm_state, packed
+
+    def run_block(self, tok: np.ndarray, pos: np.ndarray,
+                  active: np.ndarray, n_rounds: int,
+                  remaining: Optional[np.ndarray] = None
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+        """Same packed contract as SpecChainEngine.run_block: the committed
+        tokens for slot r in round k are ``a[r, k, :n_acc[r, k] + 1]``
+        (accepted path + bonus); n_acc == -1 marks an idle round."""
+        n_rounds = min(int(n_rounds), self.max_rounds)
+        if remaining is None:
+            remaining = np.full(tok.shape, np.iinfo(np.int32).max // 2,
+                                np.int32)
+        (self.llm.op_state, self.ssm.op_state, packed) = self._block(
+            self.llm.params, self.llm.op_state, self.ssm.params,
+            self.ssm.op_state, jnp.asarray(tok), jnp.asarray(pos),
+            jnp.asarray(active), jnp.int32(n_rounds),
+            jnp.asarray(remaining, jnp.int32))
+        packed = np.asarray(packed)
+        return packed[:, :, :-1], packed[:, :, -1]

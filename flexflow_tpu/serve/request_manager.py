@@ -362,9 +362,18 @@ class RequestManager:
                 f"max_beam_width={widths}; rebuild the SSMs with the "
                 f"requested width (FFConfig.max_beam_width)")
         if W > 1:
-            # beam drafting runs the host tree path: frontier nodes step
-            # through the draft as STAGED TREE NODES (no per-beam KV), and
-            # the surviving beam paths merge like extra chains
+            if len(ssms) == 1 and not llm.config.inference_debugging:
+                # single-draft beams run fully fused: the beam tree's NODE
+                # LAYOUT is compile-time static (frontier = the newest W
+                # nodes), so drafting + verify + accept + commit all run
+                # inside one device while_loop (engine.BeamSpecEngine)
+                return self._generate_spec_chain(llm, ssms[0],
+                                                 spec_depth=spec_depth,
+                                                 beam_width=W)
+            # multi-SSM beams (merged cross-draft trees) and debug dumps
+            # run the host tree path: frontier nodes step through the
+            # draft as STAGED TREE NODES (no per-beam KV), and the
+            # surviving beam paths merge like extra chains
             return self._generate_spec_tree_host(llm, ssms,
                                                  spec_depth=spec_depth,
                                                  beam_width=W)
@@ -503,16 +512,20 @@ class RequestManager:
         return done
 
     def _generate_spec_chain(self, llm, ssm,
-                             spec_depth: Optional[int] = None
+                             spec_depth: Optional[int] = None,
+                             beam_width: int = 1
                              ) -> List[GenerationResult]:
-        """Single-SSM speculative decoding with the fused chain engine.
+        """Single-SSM speculative decoding with a fused engine: the chain
+        engine at beam_width 1, the beam engine (static-layout beam tree
+        drafting, engine.BeamSpecEngine) at width > 1.
 
-        Each device call runs SPEC_ROUNDS_PER_CALL full rounds (draft scan +
+        Each device call runs SPEC_ROUNDS_PER_CALL full rounds (draft +
         verify + accept) via serve/engine.py; the host walks the returned
         (a, n_acc) blocks, committing ``a[slot, k, :n_acc+1]`` per round and
-        reconciling EOS / length limits.
+        reconciling EOS / length limits (both engines share the packed
+        block contract).
         """
-        from flexflow_tpu.serve.engine import SpecChainEngine
+        from flexflow_tpu.serve.engine import BeamSpecEngine, SpecChainEngine
 
         llm_ifm = getattr(llm, "_inference_manager", None)
         if llm_ifm is None:
@@ -524,10 +537,28 @@ class RequestManager:
         R = cfg.max_requests_per_batch
         max_seq = cfg.max_sequence_length
         depth = min(spec_depth or self.max_spec_depth, self.max_spec_depth)
-        engine = getattr(llm, "_chain_engine", None)
-        if engine is None or engine.ssm is not ssm or engine.depth != depth:
-            engine = llm._chain_engine = SpecChainEngine(
-                llm, ssm, depth, max_rounds=cfg.spec_rounds_per_call)
+        if beam_width > 1:
+            engine = getattr(llm, "_beam_engine", None)
+            if (engine is None or engine.ssm is not ssm
+                    or engine.depth != depth
+                    or engine.width != beam_width):
+                engine = llm._beam_engine = BeamSpecEngine(
+                    llm, ssm, depth, beam_width,
+                    max_rounds=cfg.spec_rounds_per_call)
+            # the beam engine stages a Tp-node tree per round; its
+            # live_mask reserves the full window, so the host must gate
+            # at least as strictly or cramped requests would be
+            # rescheduled into an engine that masks them dead every
+            # round, hanging the loop. (NB: named room_needed, not room —
+            # the per-request budget remainder below shadows that name.)
+            room_needed = engine.tree_width
+        else:
+            engine = getattr(llm, "_chain_engine", None)
+            if (engine is None or engine.ssm is not ssm
+                    or engine.depth != depth):
+                engine = llm._chain_engine = SpecChainEngine(
+                    llm, ssm, depth, max_rounds=cfg.spec_rounds_per_call)
+            room_needed = depth + 1
         chunk = max(1, cfg.max_tokens_per_batch // max(1, min(R, 4)))
         active: List[Optional[Request]] = [None] * R
         done: List[GenerationResult] = []
@@ -547,7 +578,7 @@ class RequestManager:
                     # tail tokens go through the single-step fallback anyway.
                     rows = [(slot, toks, sp) for slot, toks, sp in rows
                             if max_seq - len(active[slot].tokens) - 1
-                            >= depth + 1]
+                            >= room_needed]
                 if rows:
                     meta = self._meta_from_rows(R, chunk, rows)
                     ifm.step(meta, want_output=False)
@@ -570,9 +601,10 @@ class RequestManager:
                 # path below. The device loop also guards per request and
                 # exits early once every budget is drafted.
                 draftable = [req for req in live
-                             if max_seq - len(req.tokens) - 1 >= depth + 1]
+                             if max_seq - len(req.tokens) - 1
+                             >= room_needed]
                 cramped = [req for req in live
-                           if max_seq - len(req.tokens) - 1 < depth + 1]
+                           if max_seq - len(req.tokens) - 1 < room_needed]
                 rounds = min(cfg.spec_rounds_per_call, engine.max_rounds)
                 if cramped:
                     # cache nearly full: finish remaining tokens one by one

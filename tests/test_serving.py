@@ -426,12 +426,68 @@ def test_beam_draft_proposes_wider_trees():
     try:
         rm = RequestManager()
         rm.register_new_request([5, 9, 23, 44], max_new_tokens=10)
-        rm.generate_spec_infer(llm, [ssm], spec_depth=3, beam_width=2)
+        # drive the HOST beam path explicitly (the single-SSM W>1 default
+        # is now the fused BeamSpecEngine, which never calls _draft_beams;
+        # the host path remains the multi-SSM / inference_debugging route)
+        rm._generate_spec_tree_host(llm, [ssm], spec_depth=3, beam_width=2)
     finally:
         RequestManager._draft_beams = orig
     assert seen, "beam draft never ran"
     assert any(c0 != c1 for c0, c1 in
                (tuple(cs) for cs in seen)), "beams never diverged"
+
+
+def test_beam_width2_fused_matches_host_and_is_faster():
+    """The fused beam engine (BeamSpecEngine: static node layout, on-device
+    top-W + acceptance + KV commit) must produce token-identical output to
+    the host-stepped beam path, and a timed pass must not be slower
+    (reference BeamSearchBatchConfig, batch_config.h:125-126)."""
+    import time
+
+    prompts = [[5, 9, 23, 44], [7, 3, 11], [2, 8]]
+
+    def make_pair(seed=0):
+        def mk(mode, width):
+            cfg = ff.FFConfig(max_requests_per_batch=4,
+                              max_sequence_length=64,
+                              max_tokens_per_batch=16, seed=seed,
+                              kv_cache_dtype="float32",
+                              max_beam_width=width)
+            m = ff.FFModel(cfg)
+            create_llama_model(m, TINY, mode=mode)
+            m.compile(comp_mode=ff.CompMode.COMP_MODE_INFERENCE)
+            return m
+
+        return (mk(InferenceMode.TREE_VERIFY_MODE, 1),
+                mk(InferenceMode.BEAM_SEARCH_MODE, 2))
+
+    def run(path_fn):
+        llm, ssm = make_pair()
+        rm = RequestManager()
+        for p in prompts:
+            rm.register_new_request(p, max_new_tokens=16)
+        t0 = time.perf_counter()
+        res = path_fn(rm, llm, ssm)
+        dt = time.perf_counter() - t0
+        # second timed pass on warm jit caches (compile time excluded)
+        rm2 = RequestManager()
+        for p in prompts:
+            rm2.register_new_request(p, max_new_tokens=16)
+        t0 = time.perf_counter()
+        path_fn(rm2, llm, ssm)
+        dt = time.perf_counter() - t0
+        return {tuple(r.input_tokens): r.output_tokens for r in res}, dt
+
+    fused, dt_fused = run(
+        lambda rm, llm, ssm: rm.generate_spec_infer(
+            llm, [ssm], spec_depth=3, beam_width=2))
+    host, dt_host = run(
+        lambda rm, llm, ssm: rm._generate_spec_tree_host(
+            llm, [ssm], spec_depth=3, beam_width=2))
+    assert fused == host                    # token-identical, every request
+    # fused = one device call per block vs ~depth host dispatches per
+    # round; allow slack for CPU timing noise but it must not be slower
+    assert dt_fused <= dt_host * 1.1, (dt_fused, dt_host)
 
 
 def test_beam_width_mismatch_rejected():
