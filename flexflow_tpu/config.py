@@ -61,6 +61,14 @@ class FFConfig:
     search_profile: Optional[bool] = None
     # memory-aware search (reference graph.cc:2126 lambda binary search)
     mem_search_budget: int = -1
+    # inter-slice (DCN) fabric for the search's cost model: a
+    # search.network.NetworkTopology over the num_nodes slices. The routed
+    # ring's bottleneck link bounds cross-slice collective bandwidth, so a
+    # skinny fabric steers the search toward keeping allreduce-heavy axes
+    # inside a slice (reference: NetworkedMachineModel + machine config
+    # file, machine_model.cc / network.cc; num_nodes plays the reference's
+    # node count role — groups larger than num_devices/num_nodes cross it).
+    dcn_topology: Optional[object] = None
 
     # --- execution ---
     enable_fusion: bool = True          # XLA fuses; flag kept for parity/tests

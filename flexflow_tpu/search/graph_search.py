@@ -154,7 +154,44 @@ class UnitySearch:
         metrics = self.cm.simulate(pcg, strategy)
         strategy.cost = metrics.total
         strategy.peak_memory = metrics.memory
+        # The segment DP commits to each segment's locally-best boundary
+        # layout, so a strategy that only pays off globally (pure data
+        # parallelism when model-axis collectives cross a slow DCN
+        # boundary) can be walked past. Always score the canonical DP
+        # baseline (the reference's get_basic_data_parallel_config,
+        # model.h:303) and keep the cheaper of the two.
+        dp = self._dp_baseline(pcg)
+        if dp is not None and dp.cost + self.mem_lambda * dp.peak_memory < \
+                strategy.cost + self.mem_lambda * strategy.peak_memory:
+            return dp
         return strategy
+
+    def _dp_baseline(self, pcg: PCG) -> Optional[Strategy]:
+        """Batch dim on 'data' everywhere, weights replicated — scored
+        under this search's cost model (None if the graph's batch dims
+        don't divide the data axis)."""
+        from flexflow_tpu.search.strategy import data_parallel_strategy
+
+        deg = self.axes.get("data", 1)
+        specs = []
+        for n in pcg.nodes:
+            out_nd = len(n.output_shapes[0]) if n.output_shapes else 0
+            if (out_nd and n.output_shapes[0]
+                    and n.output_shapes[0][0] % max(deg, 1) != 0):
+                return None
+            specs.append((n.name, out_nd,
+                          {w: len(s) for w, s in n.weight_shapes.items()}))
+        dp = data_parallel_strategy(specs)
+        # input specs follow the producers (batch-sharded everywhere)
+        for n in pcg.nodes:
+            st = dp.ops[n.name]
+            st.input_specs = tuple(
+                (("data",) + (None,) * (len(s) - 1)) if len(s) else ()
+                for s in n.input_shapes)
+        m = self.cm.simulate(pcg, dp)
+        dp.cost = m.total
+        dp.peak_memory = m.memory
+        return dp
 
     def optimize(self) -> Strategy:
         """Joint substitution + parallelization search (reference
@@ -313,7 +350,18 @@ def optimize_model(model, chip: str = "cpu-sim",
     re-searches with growing memory λ if HBM oversubscribes."""
     config = model.config
     n = num_devices if num_devices is not None else config.resolve_num_devices()
-    machine = MachineModel.from_name(chip, n)
+    # multi-node runs split the devices into num_nodes slices: mesh-axis
+    # groups larger than a slice pay DCN (optionally through a routed
+    # dcn_topology's bottleneck) instead of ICI in the cost model
+    per_slice = (n // config.num_nodes
+                 if config.num_nodes and config.num_nodes > 1 else None)
+    dcn_model = None
+    if config.dcn_topology is not None:
+        from flexflow_tpu.search.network import NetworkedMachineModel
+
+        dcn_model = NetworkedMachineModel(config.dcn_topology)
+    machine = MachineModel.from_name(chip, n, devices_per_slice=per_slice,
+                                     dcn_model=dcn_model)
     axes = {"data": config.data_parallelism_degree,
             "model": config.tensor_parallelism_degree,
             "expert": config.expert_parallelism_degree}

@@ -59,16 +59,41 @@ TPU_CHIPS: Dict[str, ChipSpec] = {
 
 @dataclasses.dataclass
 class MachineModel:
-    """Slice geometry + chip spec → collective/time/memory primitives."""
+    """Slice geometry + chip spec → collective/time/memory primitives.
+
+    ``dcn_model`` (optional, a network.NetworkedMachineModel over the
+    SLICES) replaces the flat ``chip.dcn_bandwidth`` for cross-slice
+    collectives with the routed inter-slice ring's bottleneck link — the
+    reference's NetworkedMachineModel exists exactly to let topology
+    change search outcomes (machine_model.cc / network.cc), and this is
+    its TPU multi-slice counterpart: a skinny DCN fabric makes the search
+    keep allreduce-heavy axes inside a slice."""
 
     chip: ChipSpec
     num_devices: int
     devices_per_slice: Optional[int] = None   # None → single slice
+    dcn_model: Optional[object] = None        # network.NetworkedMachineModel
 
     @classmethod
     def from_name(cls, chip_name: str, num_devices: int,
-                  devices_per_slice: Optional[int] = None) -> "MachineModel":
-        return cls(TPU_CHIPS[chip_name], num_devices, devices_per_slice)
+                  devices_per_slice: Optional[int] = None,
+                  dcn_model=None) -> "MachineModel":
+        return cls(TPU_CHIPS[chip_name], num_devices, devices_per_slice,
+                   dcn_model)
+
+    @property
+    def num_slices(self) -> int:
+        per = self.devices_per_slice or self.num_devices
+        return max(1, -(-self.num_devices // per))
+
+    def _dcn_ring_bw(self) -> float:
+        """Per-chip effective bandwidth of a cross-slice ring collective:
+        the slowest routed slice-to-slice path's bottleneck link
+        (network.NetworkedMachineModel.ring_bottleneck_bandwidth; a
+        disconnected fabric returns ~0, i.e. effectively infinite cost)."""
+        bw = self.dcn_model.ring_bottleneck_bandwidth(
+            list(range(self.num_slices)))
+        return max(bw, 1e-9)         # keep downstream divisions finite
 
     # ---- compute / memory primitives -------------------------------------
     def gemm_time(self, flops: float) -> float:
@@ -84,10 +109,13 @@ class MachineModel:
     # ---- collective primitives ------------------------------------------
     def _group_bw(self, group_size: int) -> float:
         """Bandwidth available to a collective over a mesh-axis group. Groups
-        that fit a slice ride ICI; larger groups are DCN-bound."""
+        that fit a slice ride ICI; larger groups are DCN-bound (through the
+        routed slice topology's bottleneck when one is modeled)."""
         per_slice = self.devices_per_slice or self.num_devices
         if group_size <= per_slice:
             return self.chip.ici_bandwidth
+        if self.dcn_model is not None:
+            return self._dcn_ring_bw()
         return self.chip.dcn_bandwidth
 
     def all_reduce_time(self, bytes_per_chip: float, group: int) -> float:

@@ -179,17 +179,27 @@ class NetworkedMachineModel:
         bw = self.routing.bottleneck_bandwidth(path)
         return hops * self.hop_latency_s + bytes_ / bw
 
+    def ring_bottleneck_bandwidth(self, nodes: Sequence[int]) -> float:
+        """Slowest routed hop of the ring over `nodes` (0.0 when any pair
+        is disconnected) — the bandwidth a ring collective is bound by.
+        Shared by allreduce_time and the search machine model's
+        cross-slice group bandwidth (machine_model._group_bw)."""
+        slowest_link = float("inf")
+        for a, b in zip(nodes, list(nodes[1:]) + [nodes[0]]):
+            path = self.routing.route(a, b)
+            if path is None:      # disconnected participants: impossible
+                return 0.0
+            slowest_link = min(slowest_link,
+                               self.routing.bottleneck_bandwidth(path))
+        return slowest_link
+
     def allreduce_time(self, nodes: Sequence[int], bytes_: float) -> float:
         """Ring allreduce along the (routed) ring over `nodes`."""
         n = len(nodes)
         if n <= 1:
             return 0.0
-        slowest_link = float("inf")
-        for a, b in zip(nodes, list(nodes[1:]) + [nodes[0]]):
-            path = self.routing.route(a, b)
-            if path is None:      # disconnected participants: impossible
-                return float("inf")
-            slowest_link = min(slowest_link,
-                               self.routing.bottleneck_bandwidth(path))
+        slowest_link = self.ring_bottleneck_bandwidth(nodes)
+        if slowest_link <= 0.0:
+            return float("inf")
         return 2.0 * bytes_ * (n - 1) / n / slowest_link \
             + 2 * (n - 1) * self.hop_latency_s

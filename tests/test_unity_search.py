@@ -485,3 +485,104 @@ def test_searched_training_bert_and_resnet50_pcgs():
             0, nclass, size=(16, 1)).astype(np.int32)
         losses = [model.train_one_batch([xs], ys) for _ in range(2)]
         assert np.isfinite(losses).all(), (name, losses)
+
+
+def _uses_model_axis(strategy):
+    for s in strategy.ops.values():
+        for spec in (list(s.weight_specs.values()) + [s.output_spec]
+                     + list(s.input_specs)):
+            if spec and "model" in spec:
+                return True
+        if "model" in s.partial_axes:
+            return True
+    return False
+
+
+def test_dcn_slice_split_raises_cross_slice_cost():
+    """Slice placement must reach search costs: the same winning megatron
+    strategy pays its [B, H] model-axis gathers over DCN instead of ICI
+    when model groups cross the slice boundary, so the sliced machine's
+    best cost is strictly worse (the gemm shrink still wins at the chip's
+    25 GB/s DCN — the strategy FLIP at skinny fabrics is the next test)."""
+    # big batch: the model-axis activation collectives scale with batch
+    # while the data-axis weight-grad sync does not
+    model = mlp_model(batch=512, hidden=2048)
+    pcg = PCG.from_model(model)
+    axes = {"data": 2, "model": 4}
+
+    def run(machine):
+        cm = CostModel(machine, axes, training=True)
+        return UnitySearch(pcg, cm, axes).optimize(), cm
+
+    one_slice, cm1 = run(MachineModel.from_name("v5e", 8))
+    # 4 nodes of 2 chips: any model-axis (degree-4) collective crosses DCN
+    sliced, cm2 = run(MachineModel.from_name("v5e", 8,
+                                             devices_per_slice=2))
+    assert _uses_model_axis(one_slice)
+    assert sliced.cost > one_slice.cost * 1.2   # DCN charged, not cosmetic
+    # the cross-slice machine charges the SAME strategy more
+    assert cm2.simulate(pcg, one_slice).total > \
+        cm1.simulate(pcg, one_slice).total
+
+
+def test_dcn_network_topology_drives_search(tmp_path):
+    """The routed slice fabric must earn its keep: a fat big-switch DCN
+    keeps cross-slice sharding viable, a skinny degree-constrained fabric
+    makes the same search avoid it (reference network.cc topology
+    generators feeding NetworkedMachineModel)."""
+    from flexflow_tpu.search.machine_model import TPU_CHIPS
+    from flexflow_tpu.search.network import (
+        NetworkedMachineModel, big_switch_topology,
+        flat_degree_constrained_topology)
+
+    model = mlp_model(batch=512, hidden=2048)
+    pcg = PCG.from_model(model)
+    axes = {"data": 2, "model": 4}
+
+    def run(topo):
+        machine = MachineModel.from_name(
+            "v5e", 8, devices_per_slice=2,
+            dcn_model=NetworkedMachineModel(topo))
+        cm = CostModel(machine, axes, training=True)
+        return UnitySearch(pcg, cm, axes).optimize(), machine
+
+    # fat switch: every slice pair connected at ICI-class bandwidth
+    fat, m_fat = run(big_switch_topology(
+        4, link_bandwidth=TPU_CHIPS["v5e"].ici_bandwidth))
+    # skinny fabric: a degree-2 ring of 1 GB/s links
+    thin, m_thin = run(flat_degree_constrained_topology(
+        4, degree=2, link_bandwidth=1e9))
+    assert m_fat._dcn_ring_bw() > m_thin._dcn_ring_bw()
+    assert _uses_model_axis(fat)
+    assert not _uses_model_axis(thin)
+    assert thin.cost >= fat.cost
+
+    # end-to-end: the same flip through FFConfig.dcn_topology + compile
+    import flexflow_tpu as ff
+    from flexflow_tpu.search import optimize_model
+
+    m1 = mlp_model(batch=512, hidden=2048)
+    m1.config.data_parallelism_degree = 2
+    m1.config.tensor_parallelism_degree = 4
+    m1.config.num_nodes = 4
+    m1.config.dcn_topology = big_switch_topology(
+        4, link_bandwidth=TPU_CHIPS["v5e"].ici_bandwidth)
+    s_fat = optimize_model(m1, chip="v5e", num_devices=8)
+    m1.config.dcn_topology = flat_degree_constrained_topology(
+        4, degree=2, link_bandwidth=1e9)
+    s_thin = optimize_model(m1, chip="v5e", num_devices=8)
+
+    def first_linear_uses_model(strategy):
+        return any("model" in spec
+                   for spec in strategy.ops["linear"].weight_specs.values())
+
+    # fat fabric: col+col+row megatron — the col->col seam's [B, H]
+    # model-axis gather is affordable. Skinny fabric: the search walks the
+    # FIRST big gemm back to data parallelism, keeping only the col->row
+    # tail pair whose cross-fabric psum is the tiny [B, 8] head output —
+    # the topology reshaped which collectives the strategy is willing to
+    # pay, which is exactly what the reference's NetworkedMachineModel
+    # exists to do.
+    assert first_linear_uses_model(s_fat)
+    assert not first_linear_uses_model(s_thin)
+    assert s_fat.ops["linear"].name != s_thin.ops["linear"].name
