@@ -58,17 +58,22 @@ class Conv2D(OpImpl):
     @staticmethod
     def forward(attrs, params, inputs, ctx):
         x = inputs[0]
+        # run the conv in the configured compute dtype (bf16 doubles MXU
+        # rate and halves activation bandwidth). No preferred_element_type:
+        # the TPU conv accumulates bf16 inputs in f32 internally anyway,
+        # and a widened output dtype breaks the primitive's transpose rule
+        # under grad (TypeError on jax 0.9)
+        cd = ctx.compute_dtype or x.dtype
         y = jax.lax.conv_general_dilated(
-            x, params["kernel"],
+            x.astype(cd), params["kernel"].astype(cd),
             window_strides=(attrs["stride_h"], attrs["stride_w"]),
             padding=[(attrs["padding_h"], attrs["padding_h"]),
                      (attrs["padding_w"], attrs["padding_w"])],
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
             feature_group_count=attrs.get("groups", 1),
-            preferred_element_type=jnp.float32,
-        ).astype(x.dtype)
+        )
         if attrs.get("use_bias", True):
-            y = y + params["bias"].reshape(1, -1, 1, 1)
+            y = y + params["bias"].astype(cd).reshape(1, -1, 1, 1)
         return [apply_activation(y, attrs.get("activation", ActiMode.AC_MODE_NONE))]
 
 

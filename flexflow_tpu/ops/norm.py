@@ -237,19 +237,23 @@ class BatchNorm(OpImpl):
         bshape = (1, -1, 1, 1) if x.ndim == 4 else (1, -1)
         state = ctx.state_in.get(ctx.layer_name)
         if ctx.training or state is None:
-            mean = jnp.mean(x, axis=reduce_axes)
-            var = jnp.var(x, axis=reduce_axes)
+            # statistics in f32: a bf16 reduction accumulator over
+            # B*H*W-sized channels loses the mean outright
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=reduce_axes)
+            var = jnp.var(xf, axis=reduce_axes)
             if state is not None:
                 ctx.state_out[ctx.layer_name] = {
                     "running_mean": (1 - momentum) * state["running_mean"]
-                    + momentum * mean.astype(jnp.float32),
+                    + momentum * mean,
                     "running_var": (1 - momentum) * state["running_var"]
-                    + momentum * var.astype(jnp.float32),
+                    + momentum * var,
                 }
         else:
-            mean = state["running_mean"].astype(x.dtype)
-            var = state["running_var"].astype(x.dtype)
-        y = (x - mean.reshape(bshape)) * jax.lax.rsqrt(var.reshape(bshape) + eps)
+            mean = state["running_mean"]
+            var = state["running_var"]
+        inv = jax.lax.rsqrt(var.reshape(bshape) + eps).astype(x.dtype)
+        y = (x - mean.astype(x.dtype).reshape(bshape)) * inv
         if "scale" in params:
             y = y * params["scale"].reshape(bshape) + params["bias"].reshape(bshape)
         if attrs.get("relu", True):
