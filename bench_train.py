@@ -91,11 +91,15 @@ def _timed_mfu(model, xs, ys, flops, steps, blocks, chip, prefix,
     """Shared MFU timing harness. Drives the jitted step directly:
     train_one_batch's float(loss) is a full device sync + host readback
     per step — fine for training, but a remote-runtime tax (~100ms) that
-    would be charged to the MFU. Two warm calls: the first compiles, the
-    second absorbs the runtime's buffer-donation reshuffle. VERDICT r2:
-    report the measured distribution over repeated timing blocks, not a
-    hand-picked best — the headline MFU is the MEDIAN block; min/max
-    expose run-to-run jitter."""
+    would be charged to the MFU. (The fused multi-step block,
+    FFModel.train_batches, is deliberately NOT used here: XLA lowers
+    convolutions markedly worse inside a scan region — measured 17x
+    slower for ResNet-50 — so back-to-back async step dispatches are
+    both the honest and the faster drive.) Two warm calls: the first
+    compiles, the second absorbs the runtime's buffer-donation
+    reshuffle. VERDICT r2: report the measured distribution over
+    repeated timing blocks, not a hand-picked best — the headline MFU is
+    the MEDIAN block; min/max expose run-to-run jitter."""
     import jax
     import jax.numpy as jnp
 

@@ -90,3 +90,57 @@ def test_weight_get_set_roundtrip():
     got = model.predict(x)
     bias = np.asarray(model.params["linear"]["bias"])
     np.testing.assert_allclose(got, np.tile(bias, (4, 1)), atol=1e-6)
+
+
+def test_train_batches_block_matches_sequential_steps():
+    """K fused train steps in one device call (FFModel.train_batches /
+    fit(steps_per_call=K) — the training twin of the serving engines'
+    fused blocks) must produce the same losses, metrics, and final
+    weights as K sequential train_one_batch calls — INCLUDING for
+    stochastic graphs: the block replicates the sequential per-step rng
+    split sequence exactly (dropout masks and the post-call rng state
+    match bit-for-bit)."""
+    x, y = make_synthetic_mnist(n=256)
+
+    def run(block):
+        cfg = ff.FFConfig(batch_size=32, seed=0)
+        m = ff.FFModel(cfg)
+        t = m.create_tensor([cfg.batch_size, 784], ff.DataType.DT_FLOAT)
+        h = m.dense(t, 512, ff.ActiMode.AC_MODE_RELU)
+        h = m.dropout(h, rate=0.3)          # stochastic: rng must match
+        m.softmax(m.dense(h, 10))
+        m.compile(optimizer=ff.SGDOptimizer(m, lr=0.01),
+                  loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[ff.MetricsType.METRICS_ACCURACY])
+        losses = []
+        if block:
+            for i in range(0, 256, 32 * 4):    # blocks of K=4 steps
+                bx = np.stack([x[j:j + 32] for j in range(i, i + 128, 32)])
+                by = np.stack([y[j:j + 32] for j in range(i, i + 128, 32)])
+                losses.extend(m.train_batches([bx], by))
+        else:
+            for i in range(0, 256, 32):
+                losses.append(m.train_one_batch([x[i:i + 32]], y[i:i + 32]))
+        w = m.get_parameter_by_key(("linear", "kernel"))
+        return losses, m._metrics_summary(), w
+
+    seq_losses, seq_met, seq_w = run(block=False)
+    blk_losses, blk_met, blk_w = run(block=True)
+    np.testing.assert_allclose(seq_losses, blk_losses, rtol=1e-5, atol=1e-6)
+    assert seq_met.keys() == blk_met.keys()
+    for k in seq_met:
+        np.testing.assert_allclose(seq_met[k], blk_met[k], rtol=1e-5)
+    np.testing.assert_allclose(seq_w, blk_w, rtol=1e-5, atol=1e-6)
+
+
+def test_fit_steps_per_call_trains_and_handles_tail():
+    """fit(steps_per_call=3) over 7 minibatches (tail of 1) must learn the
+    same as plain fit."""
+    x, y = make_synthetic_mnist(n=224)      # 7 batches of 32
+    cfg = ff.FFConfig(batch_size=32, seed=0)
+    m = build_mnist_mlp(cfg)
+    m.compile(optimizer=ff.SGDOptimizer(m, lr=0.01),
+              loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+              metrics=[ff.MetricsType.METRICS_ACCURACY])
+    hist = m.fit(x=x, y=y, epochs=4, steps_per_call=3)
+    assert hist[-1]["accuracy"] > 0.9
