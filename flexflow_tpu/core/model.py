@@ -901,10 +901,39 @@ class FFModel:
                 body, (p, opt_state, state), (feeds_stack, labels, rng))
             return p, opt_state, state, losses, mets
 
+        def train_block_unrolled(K):
+            """Python-unrolled K-step block: same contract as train_block
+            but with no scan region — XLA lowers convolutions markedly
+            worse inside scan (measured ~17x on ResNet-50/v5e), so conv
+            nets amortize per-call dispatch with an unrolled block
+            instead. Compile time grows with K; keep K small (2-8)."""
+
+            def block(p, opt_state, state, feeds_stack, labels, rng):
+                losses, metlist = [], []
+                for i in range(K):
+                    feeds = {k: v[i] for k, v in feeds_stack.items()}
+                    p, opt_state, state, loss, met = train_step(
+                        p, opt_state, state, feeds, labels[i], rng[i])
+                    losses.append(loss)
+                    metlist.append(met)
+                mets = {k: jnp.stack([m[k] for m in metlist])
+                        for k in metlist[0]}
+                return p, opt_state, state, jnp.stack(losses), mets
+
+            return jax.jit(block, donate_argnums=(0, 1, 2))
+
         if optimizer is not None:
             self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
             self._train_block = jax.jit(train_block,
                                         donate_argnums=(0, 1, 2))
+            self._unrolled_blocks = {}
+
+            def _get_unrolled(K):
+                if K not in self._unrolled_blocks:
+                    self._unrolled_blocks[K] = train_block_unrolled(K)
+                return self._unrolled_blocks[K]
+
+            self._train_block_unrolled = _get_unrolled
         self._eval_step = jax.jit(eval_step)
         self._predict_step = jax.jit(predict_step)
         self._compiled = True
@@ -975,7 +1004,8 @@ class FFModel:
         self._perf.update({k: float(v) for k, v in step_metrics.items()}, bs)
         return float(loss)
 
-    def train_batches(self, xs: List[np.ndarray], y: np.ndarray):
+    def train_batches(self, xs: List[np.ndarray], y: np.ndarray,
+                      unroll: bool = False):
         """Run K train steps in ONE device call (lax.scan block).
 
         ``xs``: per-input arrays stacked [K, batch, ...]; ``y``:
@@ -985,7 +1015,8 @@ class FFModel:
         the next K batches can be staged up front — fit(steps_per_call=K)
         does the batching for you. Caveat: XLA lowers CONVOLUTIONS
         markedly worse inside the scan region (measured ~17x slower on
-        ResNet-50 on v5e) — use only for matmul-dominated graphs.
+        ResNet-50 on v5e) — pass ``unroll=True`` for conv graphs to use a
+        python-unrolled block (no scan region, per-K compile cache).
         """
         assert self._compiled and self.optimizer is not None
         K = y.shape[0]
@@ -1019,10 +1050,12 @@ class FFModel:
         import time as _time
 
         t0 = _time.perf_counter() if self.config.profiling else 0.0
+        block_fn = (self._train_block_unrolled(K) if unroll
+                    else self._train_block)
         (self.params, self.opt_state, self.op_state, losses,
-         mets) = self._train_block(self.params, self.opt_state,
-                                   self.op_state, feeds_stack, labels,
-                                   block_rngs)
+         mets) = block_fn(self.params, self.opt_state,
+                          self.op_state, feeds_stack, labels,
+                          block_rngs)
         losses = np.asarray(losses)              # fences the block
         if self.config.profiling:
             # --profiling parity with train_one_batch: per-step timing
@@ -1038,7 +1071,8 @@ class FFModel:
 
     def fit(self, x=None, y=None, batch_size: Optional[int] = None,
             epochs: Optional[int] = None, shuffle: bool = False,
-            initial_epoch: int = 0, steps_per_call: int = 1):
+            initial_epoch: int = 0, steps_per_call: int = 1,
+            unroll: bool = False):
         """Keras-style fit (reference flexflow_cffi.py:3534).
 
         ``initial_epoch`` offsets the shuffle seed so outer epoch loops
@@ -1069,7 +1103,7 @@ class FFModel:
                 if len(pend) == steps_per_call:
                     losses.extend(self.train_batches(
                         [np.stack(a) for a in zip(*(p[0] for p in pend))],
-                        np.stack([p[1] for p in pend])))
+                        np.stack([p[1] for p in pend]), unroll=unroll))
                     pend = []
             for bxs, by in pend:        # epoch tail < steps_per_call
                 losses.append(self.train_one_batch(bxs, by))

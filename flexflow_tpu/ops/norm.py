@@ -238,10 +238,15 @@ class BatchNorm(OpImpl):
         state = ctx.state_in.get(ctx.layer_name)
         if ctx.training or state is None:
             # statistics in f32: a bf16 reduction accumulator over
-            # B*H*W-sized channels loses the mean outright
+            # B*H*W-sized channels loses the mean outright. One-pass form
+            # (E[x^2] - mean^2): both reductions fuse into the producing
+            # conv's epilogue instead of forcing a second activation read
+            # the two-pass jnp.var form needs.
             xf = x.astype(jnp.float32)
             mean = jnp.mean(xf, axis=reduce_axes)
-            var = jnp.var(xf, axis=reduce_axes)
+            var = jnp.maximum(
+                jnp.mean(jnp.square(xf), axis=reduce_axes) - jnp.square(mean),
+                0.0)
             if state is not None:
                 ctx.state_out[ctx.layer_name] = {
                     "running_mean": (1 - momentum) * state["running_mean"]
@@ -252,10 +257,17 @@ class BatchNorm(OpImpl):
         else:
             mean = state["running_mean"]
             var = state["running_var"]
-        inv = jax.lax.rsqrt(var.reshape(bshape) + eps).astype(x.dtype)
-        y = (x - mean.astype(x.dtype).reshape(bshape)) * inv
+        # fold normalization + affine into one scale/shift in f32, then a
+        # single fused multiply-add pass over the activation in its dtype
+        rstd = jax.lax.rsqrt(var.astype(jnp.float32) + eps)
+        scale = rstd
+        shift = -mean.astype(jnp.float32) * rstd
         if "scale" in params:
-            y = y * params["scale"].reshape(bshape) + params["bias"].reshape(bshape)
+            g = params["scale"].astype(jnp.float32)
+            scale = rstd * g
+            shift = shift * g + params["bias"].astype(jnp.float32)
+        y = x * scale.astype(x.dtype).reshape(bshape) \
+            + shift.astype(x.dtype).reshape(bshape)
         if attrs.get("relu", True):
             y = jax.nn.relu(y)
         return [y]
