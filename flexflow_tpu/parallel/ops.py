@@ -129,3 +129,42 @@ class ReplicateOrAllReduce(_ParallelOp):
     def forward(attrs, params, inputs, ctx):
         x = inputs[0]
         return [_constrain(x, ctx.mesh, [None] * x.ndim)]
+
+
+def branch_parallel_apply(mesh, axis, branch_fns, out_channels, x):
+    """Execute independent branch subgraphs on DISJOINT device slices of a
+    mesh axis — the runtime form of a searched nonsequence split
+    (reference NonsequenceSplit, include/flexflow/graph.h:156;
+    search/graph_search.py _try_nonsequence_splits produces the
+    OpStrategy.branch tags this realizes).
+
+    Inside ``jax.shard_map`` over ``axis`` every device slice evaluates
+    only ITS branch via ``lax.switch`` on its axis index; branch outputs
+    are zero-padded on the channel dim to a common width, all-gathered,
+    and returned as per-branch arrays with their true channel counts (the
+    caller concats/consumes them). Branches must agree on every dim
+    except dim 1 (channels). ``x`` is consumed replicated."""
+    import jax.numpy as jnp
+
+    nb = mesh.shape[axis]
+    assert len(branch_fns) == nb == len(out_channels)
+    cmax = max(out_channels)
+
+    def padded(f, c):
+        def g(v):
+            y = f(v)
+            pad = [(0, 0)] * y.ndim
+            pad[1] = (0, cmax - c)
+            return jnp.pad(y, pad)
+        return g
+
+    fns = [padded(f, c) for f, c in zip(branch_fns, out_channels)]
+
+    def local(xl):
+        i = jax.lax.axis_index(axis)
+        y = jax.lax.switch(i, fns, xl)           # [B, Cmax, ...]
+        return jax.lax.all_gather(y, axis)       # [nb, B, Cmax, ...]
+
+    out = jax.shard_map(local, mesh=mesh, in_specs=P(), out_specs=P(),
+                        check_vma=False)(x)
+    return [out[i, :, :c] for i, c in enumerate(out_channels)]

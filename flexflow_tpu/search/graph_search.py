@@ -163,8 +163,60 @@ class UnitySearch:
         dp = self._dp_baseline(pcg)
         if dp is not None and dp.cost + self.mem_lambda * dp.peak_memory < \
                 strategy.cost + self.mem_lambda * strategy.peak_memory:
-            return dp
-        return strategy
+            strategy = dp
+        return self._try_nonsequence_splits(pcg, strategy)
+
+    def _try_nonsequence_splits(self, pcg: PCG,
+                                strategy: Strategy) -> Strategy:
+        """Vertical nonsequence splits (reference NonsequenceSplit,
+        graph.h:156; find_optimal_nonsequence_graph_time graph.h:181-196):
+        for every fork-join region whose branches are independent, try
+        pinning each branch to a DISJOINT slice of the data axis. Branch
+        ops are re-optimized under the scaled axes (data/nb) and tagged
+        with ``OpStrategy.branch``; the overlap simulator then runs the
+        branch timelines concurrently. The split is kept only when the
+        simulated step time improves — Inception/DLRM-style branchy PCGs
+        are where it wins; straight-line transformers never trigger it."""
+        d = self.axes.get("data", 1)
+        if d < 2:
+            return strategy
+        fork_joins = pcg.fork_joins()
+        if not fork_joins:
+            return strategy
+        import dataclasses as _dc
+
+        best = strategy
+        m = self.cm.simulate(pcg, best)
+        best_score = m.total + self.mem_lambda * m.memory
+        for (f, j, branches) in fork_joins:
+            nb = len(branches)
+            if nb < 2 or d % nb != 0:
+                continue
+            scaled = dict(self.axes)
+            scaled["data"] = d // nb
+            trial = Strategy(ops=dict(best.ops))
+            saved_cm, saved_axes, saved_pcg = self.cm, self.axes, self.pcg
+            self.cm = CostModel(saved_cm.machine, scaled,
+                                training=saved_cm.training,
+                                overlap=saved_cm.overlap)
+            self.axes = scaled
+            self.pcg = pcg               # _candidate_delta reads producers
+            try:
+                for bi, comp in enumerate(branches):
+                    chosen = self._optimize_segment(
+                        [pcg.nodes[i] for i in comp], boundary={})
+                    for i, st in chosen.items():
+                        trial.ops[pcg.nodes[i].name] = _dc.replace(
+                            st, branch=(bi, nb))
+            finally:
+                self.cm, self.axes, self.pcg = saved_cm, saved_axes, saved_pcg
+            mt = self.cm.simulate(pcg, trial)
+            score = mt.total + self.mem_lambda * mt.memory
+            if score < best_score:
+                trial.cost = mt.total
+                trial.peak_memory = mt.memory
+                best, best_score = trial, score
+        return best
 
     def _dp_baseline(self, pcg: PCG) -> Optional[Strategy]:
         """Batch dim on 'data' everywhere, weights replicated — scored

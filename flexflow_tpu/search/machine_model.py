@@ -36,6 +36,13 @@ class ChipSpec:
     # fraction of peak the roofline assumes achievable (MXU util on big gemms)
     flops_efficiency: float = 0.55
     mem_efficiency: float = 0.8
+    # fixed per-op cost (HLO dispatch + fusion-boundary + pipeline-fill):
+    # the sublinear-scaling term that makes over-sharding SMALL ops lose —
+    # and branch-parallel (nonsequence-split) placement win by running
+    # fewer, bigger per-device ops concurrently. The reference captures
+    # this by MEASURING per-op costs (Op::measure_operator_cost); a pure
+    # roofline is scale-linear and would never see it.
+    op_overhead: float = 2e-6
 
 
 TPU_CHIPS: Dict[str, ChipSpec] = {
@@ -103,8 +110,10 @@ class MachineModel:
         return bytes_moved / (self.chip.hbm_bandwidth * self.chip.mem_efficiency)
 
     def op_time(self, flops: float, bytes_moved: float) -> float:
-        """Roofline: an op is MXU-bound or HBM-bound, XLA overlaps the rest."""
-        return max(self.gemm_time(flops), self.mem_time(bytes_moved))
+        """Roofline: an op is MXU-bound or HBM-bound, XLA overlaps the rest;
+        plus the fixed per-op overhead (see ChipSpec.op_overhead)."""
+        return (max(self.gemm_time(flops), self.mem_time(bytes_moved))
+                + self.chip.op_overhead)
 
     # ---- collective primitives ------------------------------------------
     def _group_bw(self, group_size: int) -> float:

@@ -160,6 +160,8 @@ class PCGNode:
                 add_attention_candidates(self, cands, data, model)
             elif t == OpType.EMBEDDING and "kernel" in self.weight_shapes:
                 add_embedding_candidates(self, cands, data, model)
+            elif t == OpType.CONV2D and "kernel" in self.weight_shapes:
+                add_conv_candidates(self, cands, data, model)
             elif t == OpType.EXPERTS:
                 add_expert_candidates(self, cands, data, model,
                                       axis_degrees)
@@ -249,6 +251,32 @@ def add_embedding_candidates(node: PCGNode, cands: List[OpStrategy],
             name=f"tp-vocab{'+dp' if dax else ''}"))
 
 
+def add_conv_candidates(node: PCGNode, cands: List[OpStrategy],
+                        data: Optional[str], model: str):
+    """Output-channel-parallel conv — the Parameter/Channel dims of the
+    SOAP space applied to convolutions (reference
+    enable_parameter_parallel, config.h:148-150; conv machine views).
+    Kernel OIHW shards O over 'model', the output channel dim follows;
+    consumers that need full channels pay an all-gather on the edge
+    (costed as resharding), while weight-gradient allreduces shrink by
+    the degree — the hybrid that beats pure DP on multi-node conv nets
+    whose grad sync crosses DCN."""
+    out_nd = len(node.output_shapes[0])
+    if out_nd < 2 or node.attrs.get("groups", 1) != 1:
+        return
+    for dax in ({None, data} if data else {None}):
+        ins = tuple(_batch(len(s), dax) for s in node.input_shapes)
+        out = list(_batch(out_nd, dax))
+        out[1] = model
+        wspecs = {"kernel": (model,) + (None,) * (
+            len(node.weight_shapes["kernel"]) - 1)}
+        if "bias" in node.weight_shapes:
+            wspecs["bias"] = (model,)
+        cands.append(OpStrategy(
+            input_specs=ins, output_spec=tuple(out), weight_specs=wspecs,
+            name=f"conv-oc{'+dp' if dax else ''}"))
+
+
 def add_expert_candidates(node: PCGNode, cands: List[OpStrategy],
                           data: Optional[str], model: str,
                           axis_degrees: Dict[str, int]):
@@ -321,3 +349,50 @@ class PCG:
             if min_src_after[p + 1] >= p:
                 splits.append(p)
         return splits
+
+    def fork_joins(self) -> List[Tuple[int, int, List[List[int]]]]:
+        """(fork, join, branches) triples: the nodes strictly between
+        ``fork`` and its nearest post-dominator ``join`` partition into
+        >= 2 internally-connected components, each wired only to
+        fork/join/itself — the structures the reference's nonsequence
+        split parallelizes across disjoint device subsets
+        (include/flexflow/graph.h:156 NonsequenceSplit;
+        find_optimal_nonsequence_graph_time graph.h:181-196). Detection
+        scans joins outward from each multi-consumer fork; nested forks
+        surface as their own (inner) triples."""
+        out = []
+        n = len(self.nodes)
+        for f in range(n):
+            if len(set(self.nodes[f].out_edges)) < 2:
+                continue
+            for j in range(f + 2, n):
+                mids = range(f + 1, j)
+                ok = all(
+                    all(e == f or f < e < j
+                        for e in self.nodes[m].in_edges)
+                    and all(f < e <= j for e in self.nodes[m].out_edges)
+                    for m in mids)
+                ok = ok and all(f <= e < j for e in self.nodes[j].in_edges)
+                ok = ok and bool(mids)
+                if not ok:
+                    continue
+                # union-find over edges internal to the region
+                parent = {m: m for m in mids}
+
+                def find(x):
+                    while parent[x] != x:
+                        parent[x] = parent[parent[x]]
+                        x = parent[x]
+                    return x
+
+                for m in mids:
+                    for e in self.nodes[m].in_edges:
+                        if e in parent:
+                            parent[find(e)] = find(m)
+                comps: Dict[int, List[int]] = {}
+                for m in mids:
+                    comps.setdefault(find(m), []).append(m)
+                if len(comps) >= 2:
+                    out.append((f, j, sorted(comps.values())))
+                break                     # nearest join only
+        return out

@@ -55,11 +55,16 @@ class OpStrategy:
     weight_specs: Dict[str, Spec] = dataclasses.field(default_factory=dict)
     partial_axes: Tuple[str, ...] = ()
     name: str = ""                       # human tag, e.g. "tp-col", "dp"
+    # Nonsequence (branch-parallel) placement: (branch_idx, n_branches)
+    # pins this op to slice branch_idx of the data axis split n_branches
+    # ways — the reference's NonsequenceSplit device-subset assignment
+    # (include/flexflow/graph.h:156). None = the op spans all devices.
+    branch: Optional[Tuple[int, int]] = None
 
     def key(self) -> str:
         return json.dumps([self.input_specs, self.output_spec,
                            sorted(self.weight_specs.items()),
-                           self.partial_axes], default=list)
+                           self.partial_axes, self.branch], default=list)
 
 
 @dataclasses.dataclass
@@ -78,6 +83,7 @@ class Strategy:
                 "weights": {k: list(v) for k, v in s.weight_specs.items()},
                 "partial": list(s.partial_axes),
                 "name": s.name,
+                **({"branch": list(s.branch)} if s.branch else {}),
             }
 
         return json.dumps({"cost": self.cost, "peak_memory": self.peak_memory,
@@ -95,6 +101,7 @@ class Strategy:
                 weight_specs={k: tuple(v) for k, v in d["weights"].items()},
                 partial_axes=tuple(d["partial"]),
                 name=d.get("name", ""),
+                branch=tuple(d["branch"]) if d.get("branch") else None,
             )
 
         return cls(ops={k: dec(v) for k, v in raw["ops"].items()},
