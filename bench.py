@@ -45,19 +45,28 @@ instead (same harness, ~2x faster wall clock).
 """
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
 SMALL = "--small" in sys.argv
+# --smoke / FF_TPU_BENCH_SMOKE=1: CI-sized geometry so the whole bench
+# path (build, warmup, gates, timing, JSON line) runs in minutes on CPU
+SMOKE = "--smoke" in sys.argv or os.environ.get("FF_TPU_BENCH_SMOKE") == "1"
 # --multi-ssm: draft with TWO truncations (2- and 3-layer) through the
 # fused MultiSpecEngine tree path instead of the single-SSM chain engine —
 # the reference's multi-SSM SpecInfer configuration
 MULTI = "--multi-ssm" in sys.argv
 
 # Verifier geometry; draft = its first DRAFT_LAYERS layers.
-if SMALL:                 # LLaMA-1.3B-class, bf16 (round-1 config)
+if SMOKE:                 # tiny CI smoke geometry
+    VOCAB, HIDDEN, INTER, LAYERS = 512, 128, 256, 4
+    HEADS, KV_HEADS = 4, 4
+    QUANT = None
+    NEW_TOKENS = 16
+elif SMALL:               # LLaMA-1.3B-class, bf16 (round-1 config)
     VOCAB, HIDDEN, INTER, LAYERS = 32000, 2048, 5504, 24
     HEADS, KV_HEADS = 16, 8
     QUANT = None
@@ -226,7 +235,11 @@ def decode_roofline(llm, ifm, steps: int = None) -> dict:
             wbytes += int(w.nbytes)
     st = llm.op_state["kv_cache"]["k"]
     L, _R, KH, S, Dp = st.shape
-    BS = _pick_block_s(S)
+    # pass the PACKED cache head dim so the KV-traffic block size matches
+    # the kernel's actual dispatch (D=64 packs 2 positions/row -> 256-pos
+    # blocks; ADVICE r3). Un-tileable shapes run the jnp fallback, which
+    # reads the WHOLE cache every step: charge S.
+    BS = _pick_block_s(S, Dp) or S
     lens = np.arange(PROMPT_LEN, PROMPT_LEN + steps)
     blocks = np.ceil((lens + 1) / BS) * BS
     kv_bytes = float(np.mean(blocks)) * 2 * R * KH * Dp * st.dtype.itemsize * L
@@ -281,7 +294,40 @@ class AcceptanceMeter:
         }
 
 
+def _bf16_companion_line():
+    """Run the bf16 1.3B-class geometry in a CHILD process and fold its
+    headline into this run's JSON line (VERDICT r3 item 7: report a bf16
+    SpecInfer line next to the int8 7B headline so speculation gains
+    aren't conflated with quantization effects). Must run BEFORE this
+    process touches the TPU — the tunnel is single-tenant."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--small",
+             "--no-mfu"],
+            capture_output=True, text=True, timeout=3000)
+        lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+        if r.returncode == 0 and lines:
+            d = json.loads(lines[-1])
+            return {
+                "bf16_config": d.get("config"),
+                "bf16_specinfer_tokens_per_s": d.get("value"),
+                "bf16_vs_baseline": d.get("vs_baseline"),
+                "bf16_incr_tokens_per_s": d.get("incr_tokens_per_s"),
+                "bf16_spec_matches_incr_first30":
+                    d.get("spec_matches_incr_first30"),
+            }
+        return {"bf16_line": f"error rc={r.returncode}: "
+                             f"{r.stderr.strip()[-200:]}"}
+    except Exception as e:                       # never lose the headline
+        return {"bf16_line": f"error: {e}"}
+
+
 def main():
+    bf16_extra = {}
+    if not SMALL and not SMOKE and "--no-bf16-line" not in sys.argv:
+        bf16_extra = _bf16_companion_line()
     import jax
 
     llm, ssm = with_retry(build_models, "model build/compile")
@@ -378,25 +424,28 @@ def main():
 
     gc.collect()   # engine<->model reference cycles pin 7B of HBM otherwise
     mfu = {}
+    no_mfu = "--no-mfu" in sys.argv or SMOKE
     try:  # never lose the serving headline (or each other) to train issues
-        from bench_train import measure_train_mfu
+        if not no_mfu:
+            from bench_train import measure_train_mfu
 
-        mfu.update(with_retry(lambda: measure_train_mfu(steps=6),
-                              "train MFU measurement"))
+            mfu.update(with_retry(lambda: measure_train_mfu(steps=6),
+                                  "train MFU measurement"))
     except Exception as e:
         mfu["train_mfu"] = f"error: {e}"
     try:
-        from bench_train import measure_resnet_mfu
+        if not no_mfu:
+            from bench_train import measure_resnet_mfu
 
-        mfu.update(with_retry(lambda: measure_resnet_mfu(steps=4),
-                              "resnet MFU measurement"))
+            mfu.update(with_retry(lambda: measure_resnet_mfu(steps=4),
+                                  "resnet MFU measurement"))
     except Exception as e:
         mfu["resnet_train_mfu"] = f"error: {e}"
 
     m30, m_full = matches(30), matches(NEW_TOKENS)
     print(json.dumps({
         "metric": "specinfer_tokens_per_s",
-        "config": ("llama-1.3B-class bf16" if SMALL
+        "config": ("ci-smoke" if SMOKE else "llama-1.3B-class bf16" if SMALL
                    else "llama-2-7B-geometry int8"),
         "value": round(spec_tps, 2),
         "unit": "tokens/s",
@@ -417,6 +466,7 @@ def main():
         # each path (fused loops trace once however many steps execute)
         "attention_fast_path_traces": ffk.fast_path_count,
         "attention_fallback_traces": dict(ffk.fallback_counts),
+        **bf16_extra,
         **mfu,
     }), flush=True)
     # the reference CI gate, enforced (not footnoted): every request's

@@ -182,13 +182,20 @@ def _stream_attend(len_ref, appos_ref, q_ref, qp_ref, slopes_ref, knew_ref,
     # Without this, every program eats its first fetch's full HBM latency
     # serially — measured ~1/3 of the whole kernel time at decode shapes
     # (nb == 1-2, where in-program double buffering never engages).
-    g0 = jax.lax.fori_loop(
-        0, R, lambda j, a: a + jnp.where(j < r, nb_of(j), 0), jnp.int32(0))
-    prev_live = jax.lax.fori_loop(
-        0, R, lambda j, a: a | ((j < r) & (nb_of(j) > 0)), False)
-    r_next = jax.lax.fori_loop(
-        0, R, lambda j, a: jnp.where((j > r) & (nb_of(j) > 0)
-                                     & (a == R), j, a), jnp.int32(R))
+    def _pipe_scan(j, carry):
+        # single O(R) pass computing all three pipeline coordinates
+        # (ADVICE r3: three separate fori_loops re-evaluated nb_of(j)
+        # per loop — O(R^2) scalar-unit work per grid program)
+        g0, prev_live, r_next = carry
+        nbj = nb_of(j)
+        g0 = g0 + jnp.where(j < r, nbj, 0)
+        prev_live = prev_live | ((j < r) & (nbj > 0))
+        r_next = jnp.where((j > r) & (nbj > 0) & (r_next == R), j, r_next)
+        return g0, prev_live, r_next
+
+    g0, prev_live, r_next = jax.lax.fori_loop(
+        0, R, _pipe_scan,
+        (jnp.int32(0), jnp.asarray(False), jnp.int32(R)))
 
     def dmas(row, slot, i):
         yield pltpu.make_async_copy(

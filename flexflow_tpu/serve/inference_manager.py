@@ -41,23 +41,32 @@ class InferenceManager:
         self._debug_step = 0
         self.decode_width = self._resolve_decode_width(cfg)
 
-    @staticmethod
-    def _resolve_decode_width(cfg) -> int:
+    def _resolve_decode_width(self, cfg) -> int:
         """Step width for fused incremental decode (config.decode_width;
         0 = auto). Widths > 1 make decode verify-consistent — identical
         program shapes to the spec verify pass, so near-tie argmaxes
         resolve identically in both (the reference's spec-vs-incr 30-token
         CI gate). Auto picks the sublane-padded single-SSM verify width
-        only when the Pallas kernel will actually serve this config
-        (use_pallas AND a tileable cache length — mirroring _attend's
-        dispatch guard); everywhere else the jnp path runs in fp32 with no
-        bf16 near-tie problem, so wide queries would be pure waste."""
+        only when the Pallas kernel will actually serve this config:
+        use_pallas AND supports_shapes(S, Dp) at the model's PADDED cache
+        head dims — the exact predicate _attend dispatches on (ADVICE r3:
+        the former supports_seq_len(S) check assumed D=128 and could
+        disagree with the kernel for packed-D layouts). Everywhere else
+        the jnp path runs in fp32 with no bf16 near-tie problem, so wide
+        queries would be pure waste."""
         if cfg.decode_width:
             return int(cfg.decode_width)
         from flexflow_tpu import kernels as ffk
-        from flexflow_tpu.kernels.attention import SUBLANE, supports_seq_len
+        from flexflow_tpu.kernels.attention import SUBLANE, supports_shapes
+        from flexflow_tpu.ops.inc_attention import padded_head_dim
 
-        if ffk.use_pallas(cfg) and supports_seq_len(cfg.max_sequence_length):
+        if not ffk.use_pallas(cfg):
+            return 1
+        S = cfg.max_sequence_length
+        dps = {padded_head_dim(layer.attrs["head_dim"], True, S)
+               for layer in self.model.layers
+               if "head_dim" in layer.attrs and "num_kv_heads" in layer.attrs}
+        if dps and all(supports_shapes(S, dp) for dp in dps):
             # SUBLANE == MultiSpecEngine.tree_width for the single-SSM
             # depth-4 default (1 + 4 rounded up to the sublane), and the
             # Pallas path always specs through that engine

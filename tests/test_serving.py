@@ -7,6 +7,9 @@ deterministic, (b) spec-infer output must token-match incremental decoding
 (check_partial_token_match :29), (c) batching must not change results.
 """
 
+import os
+import warnings
+
 import numpy as np
 import pytest
 
@@ -486,8 +489,14 @@ def test_beam_width2_fused_matches_host_and_is_faster():
             llm, [ssm], spec_depth=3, beam_width=2))
     assert fused == host                    # token-identical, every request
     # fused = one device call per block vs ~depth host dispatches per
-    # round; allow slack for CPU timing noise but it must not be slower
-    assert dt_fused <= dt_host * 1.1, (dt_fused, dt_host)
+    # round. Token identity is the hard contract; wall-clock comparison
+    # is informational by default (flaky on loaded CI machines) and only
+    # enforced under FF_TPU_STRICT_TIMING=1 (ADVICE r3).
+    if os.environ.get("FF_TPU_STRICT_TIMING") == "1":
+        assert dt_fused <= dt_host * 1.1, (dt_fused, dt_host)
+    elif dt_fused > dt_host * 1.1:
+        warnings.warn(f"fused beam block slower than host loop: "
+                      f"{dt_fused:.3f}s vs {dt_host:.3f}s (informational)")
 
 
 def test_beam_width_mismatch_rejected():
