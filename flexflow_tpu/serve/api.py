@@ -17,7 +17,8 @@ MachineView assignments.
 from __future__ import annotations
 
 import os
-from typing import Any, List, Optional, Sequence, Union
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.ffconst import CompMode, DataType, InferenceMode
@@ -98,6 +99,7 @@ class LLM:
         self.ffmodel = None
         self.ssms: List["SSM"] = []
         self.rm: Optional[RequestManager] = None
+        self._server: Optional[_BackgroundServer] = None
 
         if isinstance(model, (tuple, list)) and len(model) == 2:
             self.hf_config, self._state_dict = model
@@ -228,25 +230,132 @@ class LLM:
             requests_or_prompts and
             isinstance(requests_or_prompts[0], int))
         prompts = [requests_or_prompts] if single else list(requests_or_prompts)
-        guids = [self.rm.register_new_request(
-            p, max_new_tokens=max_new_tokens, max_sequence_length=max_length)
-            for p in prompts]
-        if self.ssms:
-            self.rm.generate_spec_infer(
-                self.ffmodel, [s.ffmodel for s in self.ssms])
+        if self._server is not None:
+            # server mode: enqueue into the background loop's continuous
+            # batch and block until THIS submission's requests finish;
+            # concurrent generate() calls from other threads interleave
+            # into the same running batch
+            srv = self._server
+            guids, ev = srv.submit(prompts, max_new_tokens, max_length)
+            ev.wait()
+            if srv._error is not None:
+                raise RuntimeError("serving loop died") from srv._error
         else:
-            self.rm.generate_incr_decoding(self.ffmodel)
+            guids = [self.rm.register_new_request(
+                p, max_new_tokens=max_new_tokens,
+                max_sequence_length=max_length) for p in prompts]
+            if self.ssms:
+                self.rm.generate_spec_infer(
+                    self.ffmodel, [s.ffmodel for s in self.ssms])
+            else:
+                self.rm.generate_incr_decoding(self.ffmodel)
         # prompt order, not completion order (results[i] pairs with prompts[i])
         results = [self.rm.results[g] for g in guids]
         return results[0] if single else results
 
-    # parity no-ops: the reference starts a background RequestManager server
-    # (serve.py start_server); our generate loops run inline.
+    # ------------------------------------------------------------------
     def start_server(self):
+        """Start the background RequestManager server (reference
+        serve.py start_server): a daemon thread owns the generation step
+        loop and a thread-safe submission queue, so concurrent
+        ``generate`` calls interleave into one running continuous batch.
+        The device is only ever driven from the server thread."""
+        if self.ffmodel is None:
+            raise RuntimeError("call LLM.compile() before start_server()")
+        if self._server is None:
+            self._server = _BackgroundServer(self)
+            self._server.start()
         return self
 
     def stop_server(self):
+        """Drain outstanding requests and stop the background server."""
+        srv = self._server
+        if srv is not None:
+            srv.stop()
+            self._server = None
         return self
+
+
+class _BackgroundServer:
+    """Background serving loop (reference python/flexflow/serve/serve.py
+    server semantics). Submitter threads register requests under the
+    condition lock and wait on a per-submission event; the server thread
+    runs generation rounds whenever work is queued. Requests that arrive
+    while a round is in flight join its continuous batch at the next
+    slot-fill (RequestManager's loops re-poll ``pending`` every
+    iteration), so late submitters share device steps with the batch
+    already running."""
+
+    def __init__(self, llm: "LLM"):
+        self.llm = llm
+        self._work = threading.Condition()
+        self._stopping = False
+        # (remaining-guid-set, event) per submission
+        self._waiters: List[Tuple[set, threading.Event]] = []
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="flexflow-serve")
+        self._error: Optional[BaseException] = None
+
+    def start(self):
+        self._thread.start()
+
+    def submit(self, prompts, max_new_tokens: int,
+               max_length: int) -> Tuple[List[int], threading.Event]:
+        ev = threading.Event()
+        with self._work:
+            if self._error is not None:
+                raise RuntimeError("serving loop died") from self._error
+            if self._stopping or not self._thread.is_alive():
+                raise RuntimeError(
+                    "server is stopping/stopped; submit raced stop_server()")
+            guids = [self.llm.rm.register_new_request(
+                p, max_new_tokens=max_new_tokens,
+                max_sequence_length=max_length) for p in prompts]
+            self._waiters.append((set(guids), ev))
+            self._work.notify_all()
+        return guids, ev
+
+    def stop(self):
+        with self._work:
+            self._stopping = True
+            self._work.notify_all()
+        self._thread.join()
+
+    def _run(self):
+        rm = self.llm.rm
+        while True:
+            with self._work:
+                while not rm.pending and not self._stopping:
+                    self._work.wait(timeout=0.05)
+                if self._stopping and not rm.pending:
+                    # release any waiters for already-finished guids
+                    for _, ev in self._waiters:
+                        ev.set()
+                    return
+            try:
+                if self.llm.ssms:
+                    rm.generate_spec_infer(
+                        self.llm.ffmodel,
+                        [s.ffmodel for s in self.llm.ssms])
+                else:
+                    rm.generate_incr_decoding(self.llm.ffmodel)
+            except BaseException as e:       # surface to submitters
+                with self._work:
+                    self._error = e
+                    for _, ev in self._waiters:
+                        ev.set()
+                    self._waiters.clear()
+                raise
+            with self._work:
+                done = set(rm.results)
+                fire = []
+                keep = []
+                for guids, ev in self._waiters:
+                    guids -= done
+                    (keep if guids else fire).append((guids, ev))
+                self._waiters = keep
+            for _, ev in fire:
+                ev.set()
 
 
 class SSM(LLM):
