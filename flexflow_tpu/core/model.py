@@ -523,6 +523,43 @@ class FFModel:
     def gather(self, input: Tensor, index: Tensor, dim: int, name=None):
         return self._add_layer(OpType.GATHER, [input, index], dict(dim=dim), name)
 
+    # --- constants / selection (torch-frontend lowering targets) ---
+    def constant_tensor(self, value, dtype: Optional[DataType] = None,
+                        name=None):
+        """Embedded literal tensor (folded constants from traced graphs)."""
+        arr = np.asarray(value)
+        if dtype is None:
+            dtype = DataType.from_jnp(arr.dtype)
+        else:
+            arr = arr.astype(dtype.to_jnp())
+        return self._add_layer(OpType.CONSTANT, [],
+                               dict(value=arr.tolist(), dtype=dtype.value,
+                                    shape=list(arr.shape)), name)
+
+    def parameter(self, dims: Sequence[int],
+                  dtype: DataType = DataType.DT_FLOAT, init: float = 1.0,
+                  name=None):
+        """Free-standing trainable parameter (reference PCG Weight node) —
+        e.g. a bare nn.Parameter read in a traced torch module."""
+        return self._add_layer(OpType.WEIGHT, [],
+                               dict(shape=list(dims), dtype=dtype.value,
+                                    init=init), name)
+
+    def where(self, cond: Tensor, x: Tensor, y: Tensor, name=None):
+        return self._add_layer(OpType.WHERE, [cond, x, y], {}, name)
+
+    def compare(self, x: Tensor, other, cmp: str, name=None):
+        """Elementwise comparison; ``other`` is a Tensor or a scalar."""
+        if isinstance(other, Tensor):
+            return self._add_layer(OpType.COMPARE, [x, other],
+                                   dict(cmp=cmp), name)
+        return self._add_layer(OpType.COMPARE, [x],
+                               dict(cmp=cmp, scalar=float(other)), name)
+
+    def broadcast_to(self, input: Tensor, shape: Sequence[int], name=None):
+        return self._add_layer(OpType.BROADCAST_TO, [input],
+                               dict(shape=list(shape)), name)
+
     def top_k(self, input: Tensor, k: int, sorted: bool = True, name=None):
         return self._add_layer(OpType.TOPK, [input], dict(k=k, sorted=sorted), name)
 

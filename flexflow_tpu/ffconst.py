@@ -160,6 +160,11 @@ class OpType(enum.Enum):
     REVERSE = enum.auto()
     FLAT = enum.auto()
     CAST = enum.auto()
+    # constants / selection (torch-frontend lowering targets)
+    CONSTANT = enum.auto()
+    WHERE = enum.auto()
+    COMPARE = enum.auto()
+    BROADCAST_TO = enum.auto()
     # reductions / algebra
     SOFTMAX = enum.auto()
     BATCH_MATMUL = enum.auto()

@@ -8,6 +8,7 @@ from flexflow_tpu.ops import base  # noqa: F401
 from flexflow_tpu.ops import (  # noqa: F401
     attention,
     cache,
+    constants,
     conv,
     dropout,
     elementwise,

@@ -69,12 +69,35 @@ class PyTorchModel:
     """fx-trace a torch.nn.Module and lower it onto an FFModel
     (reference python/flexflow/torch/model.py:29 PyTorchModel)."""
 
-    def __init__(self, module, seq_length: Optional[int] = None):
+    def __init__(self, module, seq_length=None, is_hf_model: bool = False,
+                 input_names: Optional[Sequence[str]] = None,
+                 batch_size: int = 1):
+        """``is_hf_model=True`` traces through HuggingFace's fx tracer
+        (reference python/flexflow/torch/model.py:2428 hf_symbolic_trace)
+        and lowers via the constant-folding interpreter — this is the
+        path that handles encoder-decoder models (mT5/T5): size()/shape
+        arithmetic, arange/triu position-bias tables and mask algebra
+        fold to constants; only the real data path becomes FF ops.
+        ``seq_length`` may be an int or an (encoder, decoder) pair."""
         if not _HAS_TORCH:
             raise RuntimeError("torch is not available")
         self.module = module
         self.seq_length = seq_length
-        self.traced = torch.fx.symbolic_trace(module)
+        self.is_hf_model = is_hf_model
+        self.input_names = list(input_names or [])
+        self.batch_size = batch_size
+        if is_hf_model:
+            from transformers.utils.fx import \
+                symbolic_trace as hf_symbolic_trace
+
+            if hasattr(module, "config"):
+                # traced past_key_values would double the op surface for
+                # a training-oriented translation nobody consumes
+                module.config.use_cache = False
+            self.traced = hf_symbolic_trace(module,
+                                            input_names=self.input_names)
+        else:
+            self.traced = torch.fx.symbolic_trace(module)
         # drop dead nodes (e.g. the unused getitem(mha, 1) a tuple unpack
         # `out, _ = mha(...)` leaves behind)
         self.traced.graph.eliminate_dead_code()
@@ -85,6 +108,9 @@ class PyTorchModel:
     # ------------------------------------------------------------------
     def to_ir(self) -> List[IRNode]:
         if self._ir is not None:
+            return self._ir
+        if self.is_hf_model:
+            self._ir = _HFLowering(self).run()
             return self._ir
         ir: List[IRNode] = []
         mods = dict(self.traced.named_modules())
@@ -122,8 +148,21 @@ class PyTorchModel:
         self._ir = ir
         return ir
 
-    def _module_ir(self, node, mod) -> IRNode:
+    def _module_ir(self, node, mod, allow_shared: bool = False) -> IRNode:
         name = str(node.target).replace(".", "_")
+        has_params = any(True for _ in mod.parameters(recurse=False))
+        if not hasattr(self, "_module_names"):
+            self._module_names = set()
+        if name in self._module_names:
+            if has_params and not allow_shared:
+                # the HF lowering supports this (layers named per call
+                # site, weights copied per source); the plain tracer's
+                # name-based weight copy cannot
+                raise NotImplementedError(
+                    f"module {node.target!r} called twice — weight sharing "
+                    f"across call sites is not supported by this tracer")
+            name = node.name          # reused module: unique per-call name
+        self._module_names.add(name)
         ins = [a.name for a in node.args if isinstance(a, torch.fx.Node)]
         if isinstance(mod, nn.Linear):
             return IRNode("linear", name, ins, {
@@ -312,6 +351,11 @@ class PyTorchModel:
     # ------------------------------------------------------------------
     def copy_weights(self, ffmodel):
         """Copy torch parameters into the compiled FFModel's params."""
+        if self.is_hf_model:
+            # HF layers are named after their fx NODES (module aliases
+            # like encoder.embed_tokens have no layer of their own);
+            # copying walks the IR's recorded sources instead
+            return self._copy_weights_hf(ffmodel)
         for tname, mod in self.module.named_modules():
             name = tname.replace(".", "_")
             if isinstance(mod, nn.Linear):
@@ -336,6 +380,48 @@ class PyTorchModel:
                 if mod.bias is not None:
                     ffmodel.set_parameter_by_key(
                         (name, "beta"), mod.bias.detach().numpy().copy())
+    def _copy_weights_hf(self, ffmodel):
+        pdict = dict(self.module.named_parameters())
+        mdict = dict(self.module.named_modules())
+        for n in self.to_ir():
+            if n.op == "param":
+                # bare nn.Parameter reads (fx get_attr, e.g.
+                # T5LayerNorm.weight) became free-standing WEIGHT ops
+                ffmodel.set_parameter_by_key(
+                    (n.name, "weight"),
+                    pdict[n.attrs["source"]].detach().numpy().copy())
+            elif "source" in n.attrs:
+                # module-backed layers are named after their (unique) fx
+                # node — a shared module called twice copies into each
+                # call's layer
+                mod = mdict[n.attrs["source"]]
+                if isinstance(mod, nn.Linear):
+                    ffmodel.set_parameter_by_key(
+                        (n.name, "kernel"),
+                        mod.weight.detach().numpy().T.copy())
+                    if mod.bias is not None:
+                        ffmodel.set_parameter_by_key(
+                            (n.name, "bias"),
+                            mod.bias.detach().numpy().copy())
+                elif isinstance(mod, nn.Embedding):
+                    ffmodel.set_parameter_by_key(
+                        (n.name, "weight"),
+                        mod.weight.detach().numpy().copy())
+                elif isinstance(mod, nn.LayerNorm) \
+                        and mod.elementwise_affine:
+                    ffmodel.set_parameter_by_key(
+                        (n.name, "gamma"),
+                        mod.weight.detach().numpy().copy())
+                    if mod.bias is not None:
+                        ffmodel.set_parameter_by_key(
+                            (n.name, "beta"),
+                            mod.bias.detach().numpy().copy())
+                elif any(True for _ in mod.parameters(recurse=False)):
+                    # never leave a parameterized layer silently at random
+                    # init — loud failure beats a misaligned model
+                    raise NotImplementedError(
+                        f"weight copy for traced module type "
+                        f"{type(mod).__name__} ({n.attrs['source']})")
 
 
 def _mean_attrs(kwargs, positional) -> Dict[str, Any]:
@@ -358,7 +444,9 @@ def _serialize_index(idx) -> List[Dict[str, Any]]:
     for it in items:
         if it is Ellipsis:
             raise NotImplementedError("Ellipsis indexing")
-        if isinstance(it, slice):
+        if it is None:
+            out.append({"kind": "newaxis"})
+        elif isinstance(it, slice):
             if it.step not in (None, 1):
                 raise NotImplementedError("strided slicing")
             for bound in (it.start, it.stop):
@@ -372,6 +460,429 @@ def _serialize_index(idx) -> List[Dict[str, Any]]:
         else:
             raise NotImplementedError(f"index element {it!r}")
     return out
+
+
+_TORCH_DTYPE_STR = {}
+if _HAS_TORCH:
+    _TORCH_DTYPE_STR = {
+        torch.float32: "float32", torch.float64: "float64",
+        torch.float16: "float16", torch.bfloat16: "bfloat16",
+        torch.int64: "int64", torch.int32: "int32", torch.bool: "bool",
+    }
+
+
+class _HFLowering:
+    """Constant-folding lowering of a HuggingFace fx trace to IR.
+
+    The reference walks HF graphs with one Node subclass per op
+    (python/flexflow/torch/model.py); here a single interpreter pass
+    keeps an environment of either CONSTANT torch values or SYMBOLIC IR
+    names per fx node. Shape/size arithmetic, position-bias index tables
+    (arange/abs/log/triu chains) and dtype probes evaluate eagerly in
+    torch; only ops touching real input data emit IR. Shapes come from a
+    single torch ShapeProp pass at the declared (batch, seq) geometry,
+    which also resolves every view/expand target statically."""
+
+    def __init__(self, pm: "PyTorchModel"):
+        self.pm = pm
+        self.ir: List[IRNode] = []
+        self.env: Dict[Any, tuple] = {}
+        self._next_const = 0
+        self._const_cache: Dict[Any, str] = {}
+
+    # -- setup ---------------------------------------------------------
+    def _example_inputs(self):
+        B = self.pm.batch_size
+        sl = self.pm.seq_length
+        if isinstance(sl, (tuple, list)):
+            s_enc, s_dec = sl
+        else:
+            s_enc = s_dec = sl or 128
+        shapes = {"input_ids": (B, s_enc), "attention_mask": (B, s_enc),
+                  "decoder_input_ids": (B, s_dec),
+                  "decoder_attention_mask": (B, s_dec),
+                  "labels": (B, s_dec)}
+        out = []
+        for nm in self.pm.input_names:
+            if nm not in shapes:
+                raise NotImplementedError(f"input {nm!r}: no shape rule")
+            if "mask" in nm:
+                out.append(torch.ones(shapes[nm], dtype=torch.int64))
+            else:
+                out.append(torch.randint(0, 4, shapes[nm],
+                                         dtype=torch.int64))
+        return out
+
+    def _meta(self, node):
+        tm = node.meta.get("tensor_meta")
+        if tm is None:
+            raise NotImplementedError(f"no shape metadata for {node}")
+        return tm
+
+    # -- environment helpers -------------------------------------------
+    def _is_sym(self, v) -> bool:
+        return isinstance(v, torch.fx.Node) and self.env[v][0] == "sym"
+
+    def _const_val(self, v):
+        if isinstance(v, torch.fx.Node):
+            kind, val = self.env[v]
+            if kind != "const":
+                raise _NotConst()
+            return val
+        if isinstance(v, (tuple, list)):
+            return type(v)(self._const_val(x) for x in v)
+        if isinstance(v, slice):
+            return slice(self._const_val(v.start), self._const_val(v.stop),
+                         self._const_val(v.step))
+        return v
+
+    def _sym_name(self, v, dtype_like=None) -> str:
+        """IR name for a value; const tensors/scalars materialize as
+        CONSTANT nodes (memoized per fx node — a position-bias table
+        consumed by every layer serializes once, not per consumer)."""
+        src_node = None
+        if isinstance(v, torch.fx.Node):
+            kind, val = self.env[v]
+            if kind == "sym":
+                return val
+            src_node = v
+            cached = self._const_cache.get(src_node)
+            if cached is not None:
+                return cached
+            v = val
+        t = torch.as_tensor(v)
+        if dtype_like is not None:
+            t = t.to(dtype_like)
+        if t.dtype not in _TORCH_DTYPE_STR:
+            t = t.float()
+        name = f"_const{self._next_const}"
+        self._next_const += 1
+        self.ir.append(IRNode("constant", name, [], {
+            "value": t.tolist(), "dtype": _TORCH_DTYPE_STR[t.dtype],
+            "shape": list(t.shape)}))
+        if src_node is not None and dtype_like is None:
+            self._const_cache[src_node] = name
+        return name
+
+    def _emit(self, node, op: str, inputs: List[str],
+              attrs: Dict[str, Any]):
+        self.ir.append(IRNode(op, node.name, inputs, attrs))
+        self.env[node] = ("sym", node.name)
+
+    # -- main pass -----------------------------------------------------
+    def run(self) -> List[IRNode]:
+        from torch.fx.passes.shape_prop import ShapeProp
+
+        traced = self.pm.traced
+        ShapeProp(traced).propagate(*self._example_inputs())
+        mods = dict(traced.named_modules())
+        tparams = dict(traced.named_parameters())
+        tbuffers = dict(traced.named_buffers())
+        idx = 0
+        for node in traced.graph.nodes:
+            if node.op == "placeholder":
+                self.ir.append(IRNode("input", node.name, [],
+                                      {"index": idx}))
+                idx += 1
+                self.env[node] = ("sym", node.name)
+            elif node.op == "get_attr":
+                if node.target in tparams:
+                    p = tparams[node.target]
+                    name = str(node.target).replace(".", "_")
+                    self.ir.append(IRNode("param", name, [], {
+                        "shape": list(p.shape),
+                        "dtype": _TORCH_DTYPE_STR.get(p.dtype, "float32"),
+                        "source": str(node.target)}))
+                    self.env[node] = ("sym", name)
+                elif node.target in tbuffers:
+                    self.env[node] = ("const", tbuffers[node.target])
+                else:
+                    self.env[node] = ("const",
+                                      getattr(traced, node.target))
+            elif node.op == "output":
+                outs = self._output_names(node.args[0])
+                self.ir.append(IRNode("output", node.name, outs, {}))
+            elif node.op == "call_module":
+                self._lower_module(node, mods[node.target])
+            else:
+                self._lower_call(node)
+        return self.ir
+
+    def _output_names(self, out) -> List[str]:
+        if isinstance(out, dict):
+            for key in ("logits", "last_hidden_state"):
+                if key in out and isinstance(out[key], torch.fx.Node):
+                    return [self._sym_name(out[key])]
+            out = [v for v in out.values() if isinstance(v, torch.fx.Node)]
+        if isinstance(out, torch.fx.Node):
+            out = [out]
+        return [self._sym_name(o) for o in out
+                if isinstance(o, torch.fx.Node)]
+
+    def _lower_module(self, node, mod):
+        irn = self.pm._module_ir(node, mod, allow_shared=True)
+        # shared modules (e.g. T5's tied `shared` embedding) are CALLED at
+        # several fx nodes: the layer name must be the unique node name,
+        # with the module path recorded for weight copy
+        irn.name = node.name
+        irn.attrs["source"] = str(node.target)
+        irn.inputs = [self._sym_name(a) for a in node.args
+                      if isinstance(a, torch.fx.Node)]
+        self.ir.append(irn)
+        self.env[node] = ("sym", irn.name)
+
+    # -- call lowering -------------------------------------------------
+    def _lower_call(self, node):
+        t = node.target
+        fname = t if isinstance(t, str) else getattr(t, "__name__", str(t))
+        flat_args = list(node.args) + list(node.kwargs.values())
+
+        def any_sym(v):
+            if isinstance(v, torch.fx.Node):
+                return self._is_sym(v)
+            if isinstance(v, (tuple, list)):
+                return any(any_sym(x) for x in v)
+            if isinstance(v, slice):
+                return any(any_sym(x) for x in (v.start, v.stop, v.step))
+            return False
+
+        # shape/dtype probes answer from metadata even on symbolic values
+        if fname == "size":
+            src = node.args[0]
+            shape = tuple(self._meta(src).shape)
+            val = shape if len(node.args) == 1 else shape[node.args[1]]
+            self.env[node] = ("const", val)
+            return
+        if fname == "dim":
+            self.env[node] = ("const", len(self._meta(node.args[0]).shape))
+            return
+        if fname == "getattr" and isinstance(node.args[0], torch.fx.Node) \
+                and self._is_sym(node.args[0]):
+            attr = node.args[1]
+            m = self._meta(node.args[0])
+            if attr == "shape":
+                self.env[node] = ("const", tuple(m.shape))
+            elif attr == "dtype":
+                self.env[node] = ("const", m.dtype)
+            elif attr == "device":
+                self.env[node] = ("const", torch.device("cpu"))
+            else:
+                raise NotImplementedError(f"getattr {attr!r} on tensor")
+            return
+        # zeros_like/full_like on symbolic args only need the shape
+        if fname in ("zeros_like", "ones_like", "full_like") \
+                and any_sym(node.args[0]):
+            m = self._meta(node.args[0])
+            fill = {"zeros_like": 0, "ones_like": 1}.get(fname)
+            if fill is None:
+                fill = self._const_val(node.args[1])
+            self.env[node] = ("const", torch.full(tuple(m.shape), fill,
+                                                  dtype=m.dtype))
+            return
+
+        if not any(any_sym(a) for a in flat_args):
+            # pure-constant subgraph: evaluate in torch (arange/triu/
+            # position-bias tables, finfo, shape arithmetic, ...)
+            args = self._const_val(tuple(node.args))
+            kwargs = {k: self._const_val(v) for k, v in node.kwargs.items()}
+            if node.op == "call_function":
+                val = t(*args, **kwargs)
+            else:
+                val = getattr(args[0], t)(*args[1:], **kwargs)
+            self.env[node] = ("const", val)
+            return
+        self._lower_sym_call(node, fname)
+
+    def _lower_sym_call(self, node, fname: str):
+        import math
+
+        args = node.args
+        kwargs = node.kwargs
+
+        def scalar_or_none(v):
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                return float(v)
+            if isinstance(v, torch.fx.Node) and self.env[v][0] == "const":
+                c = self.env[v][1]
+                if isinstance(c, (int, float)) and not isinstance(c, bool):
+                    return float(c)
+                if isinstance(c, torch.Tensor) and c.ndim == 0:
+                    return float(c)
+            return None
+
+        binmap = {"add": "add", "sub": "subtract", "mul": "multiply",
+                  "truediv": "divide", "div": "divide"}
+        if fname in binmap:
+            a, b = args[0], args[1]
+            sa, sb = scalar_or_none(a), scalar_or_none(b)
+            if sa is not None or sb is not None:
+                x = b if sa is not None else a
+                s = sa if sa is not None else sb
+                op = "scalar_" + binmap[fname]
+                self._emit(node, op, [self._sym_name(x)],
+                           {"scalar": s, "reverse": sa is not None})
+                return
+            self._emit(node, binmap[fname],
+                       [self._sym_name(a), self._sym_name(b)], {})
+            return
+        if fname in ("eq", "ne", "lt", "le", "gt", "ge"):
+            a, b = args[0], args[1]
+            sb = scalar_or_none(b)
+            if sb is not None:
+                self._emit(node, "compare", [self._sym_name(a)],
+                           {"cmp": fname, "scalar": sb})
+            else:
+                self._emit(node, "compare",
+                           [self._sym_name(a), self._sym_name(b)],
+                           {"cmp": fname})
+            return
+        if fname in ("min", "max") and len(args) == 2:
+            # only the elementwise two-TENSOR form; torch.max(x, dim) is a
+            # reduction returning (values, indices) and must not silently
+            # lower to clamp-by-constant
+            other = args[1]
+            is_tensorish = (
+                (isinstance(other, torch.fx.Node)
+                 and (self._is_sym(other)
+                      or isinstance(self.env[other][1], torch.Tensor)))
+                or isinstance(other, torch.Tensor))
+            if not is_tensorish:
+                raise NotImplementedError(
+                    f"torch.{fname}(tensor, dim) reduction form")
+            self._emit(node, fname,
+                       [self._sym_name(args[0]), self._sym_name(args[1])],
+                       {})
+            return
+        if fname == "where":
+            self._emit(node, "where", [self._sym_name(args[0]),
+                                       self._sym_name(args[1]),
+                                       self._sym_name(args[2])], {})
+            return
+        if fname == "masked_fill":
+            x, mask, val = args[0], args[1], args[2]
+            fill_v = self._const_val(val)        # scalar (e.g. finfo.min)
+            fill = self._sym_name(torch.tensor(float(fill_v),
+                                               dtype=self._meta(x).dtype))
+            self._emit(node, "where", [self._sym_name(mask), fill,
+                                       self._sym_name(x)], {})
+            return
+        if fname == "matmul":
+            self._emit(node, "batch_matmul",
+                       [self._sym_name(args[0]), self._sym_name(args[1])],
+                       {})
+            return
+        if fname == "pow":
+            exp = scalar_or_none(args[1])
+            if exp is None:
+                raise NotImplementedError("tensor exponent")
+            self._emit(node, "pow_scalar", [self._sym_name(args[0])],
+                       {"exponent": exp})
+            return
+        if fname == "rsqrt":
+            self._emit(node, "rsqrt", [self._sym_name(args[0])], {})
+            return
+        if fname == "neg":
+            self._emit(node, "scalar_multiply", [self._sym_name(args[0])],
+                       {"scalar": -1.0})
+            return
+        if fname in ("relu", "sigmoid", "tanh", "gelu"):
+            self._emit(node, fname, [self._sym_name(args[0])], {})
+            return
+        if fname == "softmax":
+            dim = kwargs.get("dim", args[1] if len(args) > 1 else -1)
+            self._emit(node, "softmax", [self._sym_name(args[0])],
+                       {"axis": int(self._const_val(dim))})
+            return
+        if fname == "dropout":
+            p = kwargs.get("p", args[1] if len(args) > 1 else 0.5)
+            self._emit(node, "dropout", [self._sym_name(args[0])],
+                       {"rate": float(self._const_val(p))})
+            return
+        if fname == "mean":
+            positional = [self._const_val(a) for a in args[1:]]
+            self._emit(node, "mean", [self._sym_name(args[0])],
+                       _mean_attrs({k: self._const_val(v)
+                                    for k, v in kwargs.items()}, positional))
+            return
+        if fname in ("view", "reshape"):
+            self._emit(node, "reshape", [self._sym_name(args[0])],
+                       {"shape": [int(s) for s in self._meta(node).shape]})
+            return
+        if fname == "expand":
+            self._emit(node, "broadcast_to", [self._sym_name(args[0])],
+                       {"shape": [int(s) for s in self._meta(node).shape]})
+            return
+        if fname == "transpose":
+            self._emit(node, "transpose2", [self._sym_name(args[0])],
+                       {"dims": [int(self._const_val(args[1])),
+                                 int(self._const_val(args[2]))]})
+            return
+        if fname == "permute":
+            perm = args[1] if isinstance(args[1], (tuple, list)) else args[1:]
+            self._emit(node, "permute", [self._sym_name(args[0])],
+                       {"perm": [int(self._const_val(p)) for p in perm]})
+            return
+        if fname == "unsqueeze":
+            self._emit(node, "unsqueeze", [self._sym_name(args[0])],
+                       {"dim": int(self._const_val(
+                           kwargs.get("dim", args[1])))})
+            return
+        if fname in ("contiguous", "clone"):
+            self._emit(node, "identity", [self._sym_name(args[0])], {})
+            return
+        if fname == "float":
+            self._emit(node, "cast", [self._sym_name(args[0])],
+                       {"dtype": "float32"})
+            return
+        if fname == "to":
+            target = args[1] if len(args) > 1 else kwargs.get(
+                "dtype", kwargs.get("device"))
+            target = self._const_val(target)
+            if isinstance(target, torch.dtype):
+                self._emit(node, "cast", [self._sym_name(args[0])],
+                           {"dtype": _TORCH_DTYPE_STR[target]})
+            else:                               # device move: no-op
+                self._emit(node, "identity", [self._sym_name(args[0])], {})
+            return
+        if fname == "type_as":
+            dt = self._meta(args[1]).dtype if isinstance(
+                args[1], torch.fx.Node) else args[1].dtype
+            self._emit(node, "cast", [self._sym_name(args[0])],
+                       {"dtype": _TORCH_DTYPE_STR[dt]})
+            return
+        if fname == "getitem":
+            idx = self._const_val(args[1])
+            self._emit(node, "getitem", [self._sym_name(args[0])],
+                       {"index": _serialize_index(idx)})
+            return
+        if fname == "setitem":
+            x, idx, v = args
+            idx = self._const_val(idx)
+            xshape = tuple(self._meta(x).shape) if isinstance(
+                x, torch.fx.Node) else tuple(torch.as_tensor(
+                    self.env[x][1]).shape)
+            full = True
+            items = idx if isinstance(idx, tuple) else (idx,)
+            for d, it in enumerate(items):
+                if not (isinstance(it, slice) and it.step in (None, 1)
+                        and it.start in (None, 0)
+                        and (it.stop is None or it.stop >= xshape[d])):
+                    full = False
+            if not full:
+                raise NotImplementedError(
+                    "partial setitem (only whole-tensor overwrite lowers)")
+            name = self._sym_name(v)
+            # the fx trace mutates x in place: later readers of x must see
+            # the overwritten value
+            self.env[node] = ("sym", name)
+            if isinstance(x, torch.fx.Node):
+                self.env[x] = ("sym", name)
+            return
+        raise NotImplementedError(f"hf-traced op {fname}")
+
+
+class _NotConst(Exception):
+    pass
 
 
 def file_to_ff(filename: str, ffmodel, input_tensors: Sequence,
@@ -480,20 +991,61 @@ def ir_to_ff(ir: List[IRNode], ffmodel, input_tensors: Sequence,
             out = ffmodel.transpose(ins[0], perm, name=n.name)
         elif n.op == "batch_matmul":
             out = ffmodel.batch_matmul(ins[0], ins[1], name=n.name)
+        elif n.op == "constant":
+            out = ffmodel.constant_tensor(
+                np.asarray(a["value"],
+                           dtype=DataType(a["dtype"]).to_jnp()
+                           ).reshape(tuple(a["shape"])),
+                dtype=DataType(a["dtype"]), name=n.name)
+        elif n.op == "param":
+            out = ffmodel.parameter(a["shape"], dtype=DataType(a["dtype"]),
+                                    name=n.name)
+        elif n.op == "where":
+            out = ffmodel.where(ins[0], ins[1], ins[2], name=n.name)
+        elif n.op == "compare":
+            out = ffmodel.compare(ins[0],
+                                  ins[1] if len(ins) > 1 else a["scalar"],
+                                  a["cmp"], name=n.name)
+        elif n.op == "broadcast_to":
+            out = ffmodel.broadcast_to(ins[0], a["shape"], name=n.name)
+        elif n.op == "cast":
+            out = ffmodel.cast(ins[0], DataType(a["dtype"]), name=n.name)
+        elif n.op == "pow_scalar":
+            out = ffmodel.pow(ins[0], a["exponent"], name=n.name)
+        elif n.op == "rsqrt":
+            out = ffmodel.rsqrt(ins[0], name=n.name)
         elif n.op == "getitem":
             nd = ins[0].num_dims
             starts = [None] * nd
             ends = [None] * nd
             squeeze = []
-            for d, rec in enumerate(a["index"]):
+            newaxes = []          # positions in the FINAL (output) layout
+            d = 0                 # input-dim cursor
+            out_pos = 0           # output-dim cursor (ints squeeze away)
+            for rec in a["index"]:
+                if rec["kind"] == "newaxis":
+                    newaxes.append(out_pos)
+                    out_pos += 1
+                    continue
                 if rec["kind"] == "int":
                     k = rec["index"]
                     starts[d], ends[d] = k, (None if k == -1 else k + 1)
                     squeeze.append(d)
                 else:
                     starts[d], ends[d] = rec["start"], rec["stop"]
-            out = ffmodel.slice_tensor(ins[0], starts, ends,
-                                       squeeze_dims=squeeze, name=n.name)
+                    out_pos += 1
+                d += 1
+            out = ins[0]
+            if any(s is not None for s in starts) \
+                    or any(e is not None for e in ends) or squeeze:
+                out = ffmodel.slice_tensor(out, starts, ends,
+                                           squeeze_dims=squeeze,
+                                           name=n.name + "_sl"
+                                           if newaxes else n.name)
+            for i, pos in enumerate(newaxes):
+                out = ffmodel.unsqueeze(out, pos,
+                                        name=n.name if i == len(newaxes) - 1
+                                        else f"{n.name}_ua{i}")
         elif n.op == "mean":
             out = ffmodel.mean(ins[0], dims=a["dims"],
                                keepdims=a.get("keepdims", False), name=n.name)

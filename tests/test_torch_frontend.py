@@ -276,3 +276,107 @@ def test_mha_batch_first_false_rejected():
     pt = PyTorchModel(DefaultMHA())
     with pytest.raises(NotImplementedError, match="batch_first"):
         pt.to_ir()
+
+
+# ---------------------------------------------------------------------------
+# Encoder-decoder (mT5) through the HF tracer (VERDICT r3 item 5;
+# reference python/flexflow/torch/model.py is_hf_model path +
+# examples/python/pytorch/mt5)
+# ---------------------------------------------------------------------------
+transformers = pytest.importorskip("transformers")
+
+
+@pytest.fixture(scope="module")
+def tiny_mt5():
+    from transformers import MT5Config, MT5ForConditionalGeneration
+
+    torch.manual_seed(0)
+    cfg = MT5Config(vocab_size=250, d_model=64, d_kv=16, d_ff=128,
+                    num_layers=2, num_decoder_layers=2, num_heads=4,
+                    decoder_start_token_id=0, dropout_rate=0.0)
+    m = MT5ForConditionalGeneration(cfg)
+    m.eval()
+    return m
+
+
+def _build_mt5_ff(tiny_mt5, B=2, S_enc=10, S_dec=8, compile_kwargs=None):
+    pm = PyTorchModel(tiny_mt5, is_hf_model=True, batch_size=B,
+                      input_names=["input_ids", "attention_mask",
+                                   "decoder_input_ids"],
+                      seq_length=(S_enc, S_dec))
+    fm = ff.FFModel(ff.FFConfig(batch_size=B))
+    ins = [fm.create_tensor([B, S_enc], ff.DataType.DT_INT32),
+           fm.create_tensor([B, S_enc], ff.DataType.DT_INT32),
+           fm.create_tensor([B, S_dec], ff.DataType.DT_INT32)]
+    outs = pm.torch_to_ff(fm, ins)
+    assert len(outs) == 1 and outs[0].dims == (B, S_dec, 250)
+    return pm, fm, outs
+
+
+def test_mt5_traces_and_aligns_vs_torch(tiny_mt5):
+    """mt5-small-shaped encoder-decoder: HF fx trace lowers through the
+    constant-folding interpreter and the FF forward matches torch."""
+    B, S_enc, S_dec = 2, 10, 8
+    pm, fm, outs = _build_mt5_ff(tiny_mt5, B, S_enc, S_dec)
+    fm.softmax(fm.reshape(outs[0], [B * S_dec, 250]))
+    fm.compile()
+    pm.copy_weights(fm)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, 250, size=(B, S_enc)).astype(np.int32)
+    mask = np.ones((B, S_enc), np.int32)
+    dec = rng.randint(1, 250, size=(B, S_dec)).astype(np.int32)
+    with torch.no_grad():
+        ref = tiny_mt5(
+            input_ids=torch.tensor(ids, dtype=torch.long),
+            attention_mask=torch.tensor(mask, dtype=torch.long),
+            decoder_input_ids=torch.tensor(dec, dtype=torch.long),
+        ).logits.numpy()
+    probs = np.asarray(fm.predict([ids, mask, dec]))
+    ref_probs = torch.softmax(torch.tensor(ref), dim=-1).numpy().reshape(
+        B * S_dec, 250)
+    np.testing.assert_allclose(probs, ref_probs, rtol=5e-3, atol=1e-5)
+
+
+def test_mt5_trains_a_step(tiny_mt5):
+    """The translated mT5 trains: sparse-CE loss over the LM logits, one
+    SGD step, loss finite and parameters (incl. the free-standing
+    T5LayerNorm WEIGHT params) updated."""
+    B, S_enc, S_dec = 2, 10, 8
+    pm, fm, outs = _build_mt5_ff(tiny_mt5, B, S_enc, S_dec)
+    fm.softmax(fm.reshape(outs[0], [B * S_dec, 250]))
+    fm.compile(optimizer=ff.SGDOptimizer(fm, lr=0.1),
+               loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    pm.copy_weights(fm)
+    ln_layers = [ln for ln in fm.params if "layer_norm" in ln]
+    assert ln_layers, "no free-standing T5LayerNorm params translated"
+    before = np.asarray(fm.params[ln_layers[0]]["weight"]).copy()
+    rng = np.random.RandomState(1)
+    ids = rng.randint(1, 250, size=(B, S_enc)).astype(np.int32)
+    mask = np.ones((B, S_enc), np.int32)
+    dec = rng.randint(1, 250, size=(B, S_dec)).astype(np.int32)
+    labels = rng.randint(0, 250, size=(B * S_dec, 1)).astype(np.int32)
+    losses = [fm.train_one_batch([ids, mask, dec], labels)
+              for _ in range(3)]
+    assert np.isfinite(losses).all(), losses
+    after = np.asarray(fm.params[ln_layers[0]]["weight"])
+    assert not np.allclose(before, after), "layernorm params never updated"
+
+
+def test_mt5_ir_roundtrip(tiny_mt5, tmp_path):
+    """torch_to_file/file_to_ff round-trip (reference file IR path) also
+    covers the hf-lowered op set (constants, where, compare, params)."""
+    from flexflow_tpu.torch.model import file_to_ff
+
+    B, S_enc, S_dec = 2, 10, 8
+    pm = PyTorchModel(tiny_mt5, is_hf_model=True, batch_size=B,
+                      input_names=["input_ids", "attention_mask",
+                                   "decoder_input_ids"],
+                      seq_length=(S_enc, S_dec))
+    p = tmp_path / "mt5.ir"
+    pm.torch_to_file(str(p))
+    fm = ff.FFModel(ff.FFConfig(batch_size=B))
+    ins = [fm.create_tensor([B, S_enc], ff.DataType.DT_INT32),
+           fm.create_tensor([B, S_enc], ff.DataType.DT_INT32),
+           fm.create_tensor([B, S_dec], ff.DataType.DT_INT32)]
+    outs = file_to_ff(str(p), fm, ins)
+    assert outs[0].dims == (B, S_dec, 250)
