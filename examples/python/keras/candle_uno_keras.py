@@ -1,0 +1,41 @@
+"""Candle-UNO-style multi-tower regression net, keras frontend (reference
+examples/python/keras/candle_uno/)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu.keras as keras
+from flexflow_tpu.keras.models import Model, Sequential
+from flexflow_tpu.keras.layers import (
+    Activation, Add, Concatenate, Conv2D, Dense, Flatten, Input,
+    MaxPooling2D, Reshape, add, concatenate, subtract)
+from flexflow_tpu.keras.datasets import cifar10, mnist
+
+
+def top_level_task():
+    rng = np.random.RandomState(0)
+    towers = []
+    inputs = []
+    for width in (942, 5270, 2048):
+        inp = Input(shape=(width,))
+        inputs.append(inp)
+        h = Dense(256, activation="relu")(inp)
+        towers.append(Dense(128, activation="relu")(h))
+    x = Concatenate(axis=1)(towers)
+    for _ in range(3):
+        x = Dense(256, activation="relu")(x)
+    out = Dense(1)(x)
+    model = Model(inputs, out)
+    model.compile(optimizer=keras.optimizers.SGD(learning_rate=0.01),
+                  loss="mean_squared_error", metrics=["mean_squared_error"])
+    xs = [rng.randn(128, t.shape[1]).astype(np.float32) for t in inputs]
+    model.fit(x=xs, y=rng.randn(128, 1).astype(np.float32), epochs=1)
+
+
+if __name__ == "__main__":
+    top_level_task()

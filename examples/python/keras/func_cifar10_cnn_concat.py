@@ -1,0 +1,41 @@
+"""Functional CIFAR-10 CNN with branch concat (reference
+examples/python/keras/func_cifar10_cnn_concat.py)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu.keras as keras
+from flexflow_tpu.keras.models import Model, Sequential
+from flexflow_tpu.keras.layers import (
+    Activation, Add, Concatenate, Conv2D, Dense, Flatten, Input,
+    MaxPooling2D, Reshape, add, concatenate, subtract)
+from flexflow_tpu.keras.datasets import cifar10, mnist
+
+
+def top_level_task():
+    (x_train, y_train), _ = cifar10.load_data(1024)
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.astype(np.int32)
+
+    inp = Input(shape=(3, 32, 32))
+    b1 = Conv2D(32, (3, 3), padding=(1, 1), activation="relu")(inp)
+    b2 = Conv2D(32, (3, 3), padding=(1, 1), activation="relu")(inp)
+    x = Concatenate(axis=1)([b1, b2])
+    x = MaxPooling2D((2, 2), strides=(2, 2))(x)
+    x = Flatten()(x)
+    x = Dense(256, activation="relu")(x)
+    out = Activation("softmax")(Dense(10)(x))
+    model = Model(inp, out)
+    model.compile(optimizer=keras.optimizers.SGD(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x_train, y_train, epochs=1)
+
+
+if __name__ == "__main__":
+    top_level_task()

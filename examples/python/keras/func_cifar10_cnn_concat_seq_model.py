@@ -1,0 +1,46 @@
+"""Concat of a Sequential and a functional sub-model (reference
+examples/python/keras/func_cifar10_cnn_concat_seq_model.py)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu.keras as keras
+from flexflow_tpu.keras.models import Model, Sequential
+from flexflow_tpu.keras.layers import (
+    Activation, Add, Concatenate, Conv2D, Dense, Flatten, Input,
+    MaxPooling2D, Reshape, add, concatenate, subtract)
+from flexflow_tpu.keras.datasets import cifar10, mnist
+
+
+def top_level_task():
+    (x_train, y_train), _ = cifar10.load_data(1024)
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.astype(np.int32)
+
+    seq_branch = Sequential([
+        Conv2D(16, (3, 3), input_shape=(3, 32, 32), padding=(1, 1),
+               activation="relu"),
+    ])
+    ib = Input(shape=(3, 32, 32))
+    func_branch = Model(
+        ib, Conv2D(16, (3, 3), padding=(1, 1), activation="relu")(ib))
+
+    inp = Input(shape=(3, 32, 32))
+    x = concatenate([seq_branch(inp), func_branch(inp)], axis=1)
+    x = MaxPooling2D((2, 2), strides=(2, 2))(x)
+    x = Flatten()(x)
+    out = Activation("softmax")(Dense(10)(Dense(128, activation="relu")(x)))
+    model = Model(inp, out)
+    model.compile(optimizer=keras.optimizers.SGD(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x_train, y_train, epochs=1)
+
+
+if __name__ == "__main__":
+    top_level_task()

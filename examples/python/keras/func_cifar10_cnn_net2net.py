@@ -1,0 +1,54 @@
+"""Net2Net teacher->student on a CIFAR-10 CNN (reference
+examples/python/keras/func_cifar10_cnn_net2net.py)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu.keras as keras
+from flexflow_tpu.keras.models import Model, Sequential
+from flexflow_tpu.keras.layers import (
+    Activation, Add, Concatenate, Conv2D, Dense, Flatten, Input,
+    MaxPooling2D, Reshape, add, concatenate, subtract)
+from flexflow_tpu.keras.datasets import cifar10, mnist
+
+
+def top_level_task():
+    (x_train, y_train), _ = cifar10.load_data(1024)
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.astype(np.int32)
+
+    c1 = Conv2D(16, (3, 3), padding=(1, 1), activation="relu")
+    c2 = Conv2D(16, (3, 3), padding=(1, 1), activation="relu")
+    d1 = Dense(10)
+    t_in = Input(shape=(3, 32, 32))
+    x = MaxPooling2D((2, 2), strides=(2, 2))(c2(c1(t_in)))
+    t_out = Activation("softmax")(d1(Flatten()(x)))
+    teacher = Model(t_in, t_out)
+    teacher.compile(optimizer=keras.optimizers.SGD(learning_rate=0.01),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"])
+    teacher.fit(x_train, y_train, epochs=1)
+
+    sc1 = Conv2D(16, (3, 3), padding=(1, 1), activation="relu")
+    sc2 = Conv2D(16, (3, 3), padding=(1, 1), activation="relu")
+    sd1 = Dense(10)
+    s_in = Input(shape=(3, 32, 32))
+    sx = MaxPooling2D((2, 2), strides=(2, 2))(sc2(sc1(s_in)))
+    s_out = Activation("softmax")(sd1(Flatten()(sx)))
+    student = Model(s_in, s_out)
+    student.compile(optimizer=keras.optimizers.SGD(learning_rate=0.01),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"])
+    sc1.set_weights(c1.get_weights(teacher.ffmodel), student.ffmodel)
+    sc2.set_weights(c2.get_weights(teacher.ffmodel), student.ffmodel)
+    sd1.set_weights(d1.get_weights(teacher.ffmodel), student.ffmodel)
+    student.fit(x_train, y_train, epochs=1)
+
+
+if __name__ == "__main__":
+    top_level_task()

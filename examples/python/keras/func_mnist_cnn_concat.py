@@ -1,0 +1,41 @@
+"""Functional MNIST CNN with concat of conv towers (reference
+examples/python/keras/func_mnist_cnn_concat.py)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu.keras as keras
+from flexflow_tpu.keras.models import Model, Sequential
+from flexflow_tpu.keras.layers import (
+    Activation, Add, Concatenate, Conv2D, Dense, Flatten, Input,
+    MaxPooling2D, Reshape, add, concatenate, subtract)
+from flexflow_tpu.keras.datasets import cifar10, mnist
+
+
+def top_level_task():
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 1, 28, 28).astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    inp = Input(shape=(1, 28, 28))
+    t1 = Conv2D(16, (3, 3), activation="relu")(inp)
+    t2 = Conv2D(16, (3, 3), activation="relu")(inp)
+    t3 = Conv2D(16, (3, 3), activation="relu")(inp)
+    x = concatenate([t1, t2, t3], axis=1)
+    x = MaxPooling2D((2, 2))(x)
+    x = Flatten()(x)
+    out = Activation("softmax")(Dense(10)(Dense(64, activation="relu")(x)))
+    model = Model(inp, out)
+    model.compile(optimizer=keras.optimizers.SGD(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x_train, y_train, epochs=1)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,42 @@
+"""Two-input MLP with nested concats (reference
+examples/python/keras/func_mnist_mlp_concat2.py)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu.keras as keras
+from flexflow_tpu.keras.models import Model, Sequential
+from flexflow_tpu.keras.layers import (
+    Activation, Add, Concatenate, Conv2D, Dense, Flatten, Input,
+    MaxPooling2D, Reshape, add, concatenate, subtract)
+from flexflow_tpu.keras.datasets import cifar10, mnist
+
+
+def top_level_task():
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 784).astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    in1 = Input(shape=(784,))
+    in2 = Input(shape=(784,))
+    d1 = Dense(128, activation="relu")(in1)
+    d2 = Dense(128, activation="relu")(in2)
+    c1 = Concatenate(axis=1)([d1, d2])
+    d3 = Dense(64, activation="relu")(c1)
+    d4 = Dense(64, activation="relu")(c1)
+    c2 = Concatenate(axis=1)([c1, Concatenate(axis=1)([d3, d4])])
+    out = Activation("softmax")(Dense(10)(c2))
+    model = Model([in1, in2], out)
+    model.compile(optimizer=keras.optimizers.SGD(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit([x_train, x_train], y_train, epochs=1)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,57 @@
+"""Net2Net teacher->student weight transfer, functional MLP (reference
+examples/python/keras/func_mnist_mlp_net2net.py)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu.keras as keras
+from flexflow_tpu.keras.models import Model, Sequential
+from flexflow_tpu.keras.layers import (
+    Activation, Add, Concatenate, Conv2D, Dense, Flatten, Input,
+    MaxPooling2D, Reshape, add, concatenate, subtract)
+from flexflow_tpu.keras.datasets import cifar10, mnist
+
+
+def top_level_task():
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 784).astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    # teacher
+    t_in = Input(shape=(784,))
+    d1 = Dense(128, activation="relu")
+    d2 = Dense(128, activation="relu")
+    d3 = Dense(10)
+    t_out = Activation("softmax")(d3(d2(d1(t_in))))
+    teacher = Model(t_in, t_out)
+    teacher.compile(optimizer=keras.optimizers.SGD(learning_rate=0.01),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"])
+    teacher.fit(x_train, y_train, epochs=1)
+    d1_k, d1_b = d1.get_weights(teacher.ffmodel)
+    d2_k, d2_b = d2.get_weights(teacher.ffmodel)
+    d3_k, d3_b = d3.get_weights(teacher.ffmodel)
+
+    # student: same widths, seeded from the teacher
+    s_in = Input(shape=(784,))
+    sd1 = Dense(128, activation="relu")
+    sd2 = Dense(128, activation="relu")
+    sd3 = Dense(10)
+    s_out = Activation("softmax")(sd3(sd2(sd1(s_in))))
+    student = Model(s_in, s_out)
+    student.compile(optimizer=keras.optimizers.SGD(learning_rate=0.01),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"])
+    sd1.set_weights([d1_k, d1_b], student.ffmodel)
+    sd2.set_weights([d2_k, d2_b], student.ffmodel)
+    sd3.set_weights([d3_k, d3_b], student.ffmodel)
+    student.fit(x_train, y_train, epochs=1)
+
+
+if __name__ == "__main__":
+    top_level_task()

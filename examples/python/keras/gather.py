@@ -1,0 +1,41 @@
+"""keras.backend.gather demo (reference examples/python/keras/gather.py)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu.keras as keras
+from flexflow_tpu.keras.models import Model, Sequential
+from flexflow_tpu.keras.layers import (
+    Activation, Add, Concatenate, Conv2D, Dense, Flatten, Input,
+    MaxPooling2D, Reshape, add, concatenate, subtract)
+from flexflow_tpu.keras.datasets import cifar10, mnist
+from flexflow_tpu.keras.backend import gather
+
+
+def top_level_task():
+    rng = np.random.RandomState(0)
+    h = 4
+    idx = rng.randint(0, 8, size=(6, h)).astype(np.int32)
+
+    in0 = Input(shape=(16,))
+    in1 = Input(shape=idx.shape, dtype="int32")
+    x0 = Dense(32, activation="relu")(in0)
+    x0 = Reshape((8, h))(x0)
+    f0 = gather(x0, in1, axis=1)
+    f0 = Reshape((6 * h,))(f0)
+    out = Dense(1)(f0)
+    model = Model([in0, in1], out)
+    model.compile(optimizer=keras.optimizers.Adam(learning_rate=0.001),
+                  loss="mean_squared_error", metrics=["mean_squared_error"])
+    model.fit(x=[rng.randn(256, 16).astype(np.float32),
+                 idx[None].repeat(256, 0).astype(np.int32)],
+              y=rng.randn(256, 1).astype(np.float32), epochs=1)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,34 @@
+"""Identity loss: the model output IS the loss (reference
+examples/python/keras/identity_loss.py)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu.keras as keras
+from flexflow_tpu.keras.models import Model, Sequential
+from flexflow_tpu.keras.layers import (
+    Activation, Add, Concatenate, Conv2D, Dense, Flatten, Input,
+    MaxPooling2D, Reshape, add, concatenate, subtract)
+from flexflow_tpu.keras.datasets import cifar10, mnist
+from flexflow_tpu.keras import backend as K
+
+
+def top_level_task():
+    rng = np.random.RandomState(0)
+    in0 = Input(shape=(32,))
+    x0 = Dense(20, activation="relu")(in0)
+    out = K.sum(x0, axis=1)
+    model = Model(in0, out)
+    model.compile(optimizer=keras.optimizers.Adam(learning_rate=0.01),
+                  loss="identity", metrics=["mean_absolute_error"])
+    model.fit(x=rng.randn(256, 32).astype(np.float32),
+              y=np.zeros((256,), np.float32), epochs=1)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,40 @@
+"""keras.backend.sum over one and several axes (reference
+examples/python/keras/reduce_sum.py)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu.keras as keras
+from flexflow_tpu.keras.models import Model, Sequential
+from flexflow_tpu.keras.layers import (
+    Activation, Add, Concatenate, Conv2D, Dense, Flatten, Input,
+    MaxPooling2D, Reshape, add, concatenate, subtract)
+from flexflow_tpu.keras.datasets import cifar10, mnist
+from flexflow_tpu.keras import backend as K
+
+
+def run(axis, out_dim):
+    rng = np.random.RandomState(0)
+    in0 = Input(shape=(32,))
+    x0 = Dense(20, activation="relu")(in0)
+    nx0 = Reshape((10, 2))(x0)
+    out = K.sum(nx0, axis=axis)
+    model = Model(in0, out)
+    model.compile(optimizer=keras.optimizers.Adam(learning_rate=0.001),
+                  loss="mean_squared_error", metrics=["mean_squared_error"])
+    y = rng.randn(256, *out_dim).astype(np.float32)
+    model.fit(x=rng.randn(256, 32).astype(np.float32), y=y, epochs=1)
+
+
+def top_level_task():
+    run(axis=1, out_dim=(2,))
+    run(axis=[1, 2], out_dim=())
+
+
+if __name__ == "__main__":
+    top_level_task()

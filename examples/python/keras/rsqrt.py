@@ -1,0 +1,36 @@
+"""backend.internal.rsqrt + tensor arithmetic (reference
+examples/python/keras/rsqrt.py)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu.keras as keras
+from flexflow_tpu.keras.models import Model, Sequential
+from flexflow_tpu.keras.layers import (
+    Activation, Add, Concatenate, Conv2D, Dense, Flatten, Input,
+    MaxPooling2D, Reshape, add, concatenate, subtract)
+from flexflow_tpu.keras.datasets import cifar10, mnist
+from flexflow_tpu.keras.backend.internal import rsqrt
+
+
+def top_level_task():
+    rng = np.random.RandomState(0)
+    in1 = Input(shape=(32,))
+    in2 = Input(shape=(20,))
+    x = Dense(20, activation="relu")(in1)
+    out = rsqrt(x + in2)
+    model = Model([in1, in2], out)
+    model.compile(optimizer=keras.optimizers.Adam(learning_rate=0.001),
+                  loss="mean_squared_error", metrics=["mean_squared_error"])
+    model.fit(x=[rng.randn(256, 32).astype(np.float32),
+                 np.ones((256, 20), np.float32)],
+              y=rng.randn(256, 20).astype(np.float32), epochs=1)
+
+
+if __name__ == "__main__":
+    top_level_task()

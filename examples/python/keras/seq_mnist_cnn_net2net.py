@@ -1,0 +1,47 @@
+"""Net2Net with Sequential CNNs (reference
+examples/python/keras/seq_mnist_cnn_net2net.py)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu.keras as keras
+from flexflow_tpu.keras.models import Model, Sequential
+from flexflow_tpu.keras.layers import (
+    Activation, Add, Concatenate, Conv2D, Dense, Flatten, Input,
+    MaxPooling2D, Reshape, add, concatenate, subtract)
+from flexflow_tpu.keras.datasets import cifar10, mnist
+
+
+def top_level_task():
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 1, 28, 28).astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    c1 = Conv2D(16, (3, 3), input_shape=(1, 28, 28), activation="relu")
+    d1 = Dense(10)
+    teacher = Sequential([c1, MaxPooling2D((2, 2)), Flatten(), d1,
+                          Activation("softmax")])
+    teacher.compile(optimizer=keras.optimizers.SGD(learning_rate=0.01),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"])
+    teacher.fit(x_train, y_train, epochs=1)
+
+    sc1 = Conv2D(16, (3, 3), input_shape=(1, 28, 28), activation="relu")
+    sd1 = Dense(10)
+    student = Sequential([sc1, MaxPooling2D((2, 2)), Flatten(), sd1,
+                          Activation("softmax")])
+    student.compile(optimizer=keras.optimizers.SGD(learning_rate=0.01),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"])
+    sc1.set_weights(c1.get_weights(teacher.ffmodel), student.ffmodel)
+    sd1.set_weights(d1.get_weights(teacher.ffmodel), student.ffmodel)
+    student.fit(x_train, y_train, epochs=1)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,43 @@
+"""Functional merge aliases add/subtract (reference
+examples/python/keras/unary.py)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu.keras as keras
+from flexflow_tpu.keras.models import Model, Sequential
+from flexflow_tpu.keras.layers import (
+    Activation, Add, Concatenate, Conv2D, Dense, Flatten, Input,
+    MaxPooling2D, Reshape, add, concatenate, subtract)
+from flexflow_tpu.keras.datasets import cifar10, mnist
+
+
+def run(merge_fn):
+    in1 = Input(shape=(16,))
+    x1 = Dense(8, activation="relu")(in1)
+    in2 = Input(shape=(32,))
+    x2 = Dense(8, activation="relu")(in2)
+    merged = merge_fn([x1, x2])
+    out = Activation("softmax")(Dense(4)(merged))
+    model = Model([in1, in2], out)
+    model.compile(optimizer=keras.optimizers.SGD(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    rng = np.random.RandomState(0)
+    model.fit(x=[rng.randn(128, 16).astype(np.float32),
+                 rng.randn(128, 32).astype(np.float32)],
+              y=rng.randint(0, 4, size=(128, 1)).astype(np.int32), epochs=1)
+
+
+def top_level_task():
+    run(add)
+    run(subtract)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,70 @@
+"""AlexNet-shaped ONNX graph imported and trained (reference
+examples/python/onnx/alexnet.py)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.onnx import ONNXModel
+from flexflow_tpu.onnx import proto as P
+
+
+def make_model(rng, B):
+    def w(*s):
+        return (rng.randn(*s) * 0.05).astype(np.float32)
+
+    init = {
+        "k1": w(32, 3, 5, 5), "b1": np.zeros(32, np.float32),
+        "k2": w(64, 32, 3, 3), "b2": np.zeros(64, np.float32),
+        "wf": w(64 * 8 * 8, 10), "bf": np.zeros(10, np.float32),
+    }
+    nodes = [
+        P.encode_node("Conv", ["x", "k1", "b1"], ["c1"], name="conv1",
+                      kernel_shape=[5, 5], strides=[1, 1],
+                      pads=[2, 2, 2, 2]),
+        P.encode_node("Relu", ["c1"], ["r1"], name="relu1"),
+        P.encode_node("MaxPool", ["r1"], ["p1"], name="pool1",
+                      kernel_shape=[2, 2], strides=[2, 2]),
+        P.encode_node("Conv", ["p1", "k2", "b2"], ["c2"], name="conv2",
+                      kernel_shape=[3, 3], strides=[1, 1],
+                      pads=[1, 1, 1, 1]),
+        P.encode_node("Relu", ["c2"], ["r2"], name="relu2"),
+        P.encode_node("MaxPool", ["r2"], ["p2"], name="pool2",
+                      kernel_shape=[2, 2], strides=[2, 2]),
+        P.encode_node("Flatten", ["p2"], ["fl"], name="flat"),
+        P.encode_node("Gemm", ["fl", "wf", "bf"], ["o"], name="fc",
+                      transB=0),
+        P.encode_node("Softmax", ["o"], ["y"], name="sm", axis=-1),
+    ]
+    return P.encode_model(
+        nodes,
+        inputs=[P.encode_value_info("x", [B, 3, 32, 32])],
+        outputs=[P.encode_value_info("y", [B, 10])],
+        initializers=init)
+
+
+def top_level_task():
+    config = ff.FFConfig.from_args()
+    rng = np.random.RandomState(config.seed)
+    model = ff.FFModel(config)
+    t = model.create_tensor([config.batch_size, 3, 32, 32],
+                            ff.DataType.DT_FLOAT)
+    om = ONNXModel(make_model(rng, config.batch_size))
+    om.apply(model, {"x": t})
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=config.learning_rate),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY])
+    om.import_initializers(model)
+    xs = rng.randn(2 * config.batch_size, 3, 32, 32).astype(np.float32)
+    ys = rng.randint(0, 10, size=(2 * config.batch_size, 1)).astype(np.int32)
+    model.fit(xs, ys, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

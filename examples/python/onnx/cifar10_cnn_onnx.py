@@ -1,0 +1,69 @@
+"""CIFAR-10 CNN from a synthesized ONNX graph (reference
+examples/python/onnx/cifar10_cnn.py)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.onnx import ONNXModel
+from flexflow_tpu.onnx import proto as P
+from flexflow_tpu.keras.datasets import cifar10
+
+
+def make_model(rng, B):
+    def w(*s):
+        return (rng.randn(*s) * 0.05).astype(np.float32)
+
+    init = {
+        "k1": w(32, 3, 3, 3), "b1": np.zeros(32, np.float32),
+        "w1": w(32 * 16 * 16, 128), "bf1": np.zeros(128, np.float32),
+        "w2": w(128, 10), "bf2": np.zeros(10, np.float32),
+    }
+    nodes = [
+        P.encode_node("Conv", ["x", "k1", "b1"], ["c1"], name="conv1",
+                      kernel_shape=[3, 3], strides=[1, 1],
+                      pads=[1, 1, 1, 1]),
+        P.encode_node("Relu", ["c1"], ["r1"], name="relu1"),
+        P.encode_node("AveragePool", ["r1"], ["p1"], name="pool1",
+                      kernel_shape=[2, 2], strides=[2, 2]),
+        P.encode_node("Flatten", ["p1"], ["fl"], name="flat"),
+        P.encode_node("Gemm", ["fl", "w1", "bf1"], ["h"], name="fc1",
+                      transB=0),
+        P.encode_node("Relu", ["h"], ["hr"], name="relu2"),
+        P.encode_node("Gemm", ["hr", "w2", "bf2"], ["o"], name="fc2",
+                      transB=0),
+        P.encode_node("Softmax", ["o"], ["y"], name="sm", axis=-1),
+    ]
+    return P.encode_model(
+        nodes,
+        inputs=[P.encode_value_info("x", [B, 3, 32, 32])],
+        outputs=[P.encode_value_info("y", [B, 10])],
+        initializers=init)
+
+
+def top_level_task():
+    config = ff.FFConfig.from_args()
+    rng = np.random.RandomState(config.seed)
+    model = ff.FFModel(config)
+    t = model.create_tensor([config.batch_size, 3, 32, 32],
+                            ff.DataType.DT_FLOAT)
+    om = ONNXModel(make_model(rng, config.batch_size))
+    om.apply(model, {"x": t})
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=config.learning_rate),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY])
+    om.import_initializers(model)
+    (x_train, y_train), _ = cifar10.load_data(512)
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.astype(np.int32)
+    model.fit(x_train, y_train, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,74 @@
+"""Residual conv net with Add skip connections from ONNX (reference
+examples/python/onnx/resnet.py)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.onnx import ONNXModel
+from flexflow_tpu.onnx import proto as P
+
+
+def make_model(rng, B):
+    def w(*s):
+        return (rng.randn(*s) * 0.05).astype(np.float32)
+
+    init = {
+        "ks": w(16, 3, 3, 3), "bs": np.zeros(16, np.float32),
+        "k1": w(16, 16, 3, 3), "b1": np.zeros(16, np.float32),
+        "k2": w(16, 16, 3, 3), "b2": np.zeros(16, np.float32),
+        "wf": w(16 * 16 * 16, 10), "bf": np.zeros(10, np.float32),
+    }
+    nodes = [
+        P.encode_node("Conv", ["x", "ks", "bs"], ["s"], name="stem",
+                      kernel_shape=[3, 3], strides=[1, 1],
+                      pads=[1, 1, 1, 1]),
+        P.encode_node("Relu", ["s"], ["sr"], name="relu0"),
+        P.encode_node("Conv", ["sr", "k1", "b1"], ["c1"], name="conv1",
+                      kernel_shape=[3, 3], strides=[1, 1],
+                      pads=[1, 1, 1, 1]),
+        P.encode_node("Relu", ["c1"], ["r1"], name="relu1"),
+        P.encode_node("Conv", ["r1", "k2", "b2"], ["c2"], name="conv2",
+                      kernel_shape=[3, 3], strides=[1, 1],
+                      pads=[1, 1, 1, 1]),
+        P.encode_node("Add", ["c2", "sr"], ["res"], name="skip"),
+        P.encode_node("Relu", ["res"], ["rr"], name="relu2"),
+        P.encode_node("MaxPool", ["rr"], ["p"], name="pool",
+                      kernel_shape=[2, 2], strides=[2, 2]),
+        P.encode_node("Flatten", ["p"], ["fl"], name="flat"),
+        P.encode_node("Gemm", ["fl", "wf", "bf"], ["o"], name="fc",
+                      transB=0),
+        P.encode_node("Softmax", ["o"], ["y"], name="sm", axis=-1),
+    ]
+    return P.encode_model(
+        nodes,
+        inputs=[P.encode_value_info("x", [B, 3, 32, 32])],
+        outputs=[P.encode_value_info("y", [B, 10])],
+        initializers=init)
+
+
+def top_level_task():
+    config = ff.FFConfig.from_args()
+    rng = np.random.RandomState(config.seed)
+    model = ff.FFModel(config)
+    t = model.create_tensor([config.batch_size, 3, 32, 32],
+                            ff.DataType.DT_FLOAT)
+    om = ONNXModel(make_model(rng, config.batch_size))
+    om.apply(model, {"x": t})
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=config.learning_rate),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY])
+    om.import_initializers(model)
+    xs = rng.randn(2 * config.batch_size, 3, 32, 32).astype(np.float32)
+    ys = rng.randint(0, 10, size=(2 * config.batch_size, 1)).astype(np.int32)
+    model.fit(xs, ys, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

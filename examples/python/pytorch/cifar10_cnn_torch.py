@@ -1,0 +1,60 @@
+"""CIFAR-10 CNN defined in torch, traced to FF ops (reference
+examples/python/pytorch/cifar10_cnn.py)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+import torch
+import torch.nn as nn
+
+import flexflow_tpu as ff
+from flexflow_tpu.torch.model import PyTorchModel
+
+from flexflow_tpu.keras.datasets import cifar10
+
+
+class CNN(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 32, 3, padding=1)
+        self.conv2 = nn.Conv2d(32, 32, 3, padding=1)
+        self.pool1 = nn.MaxPool2d(2, 2)
+        self.conv3 = nn.Conv2d(32, 64, 3, padding=1)
+        self.pool2 = nn.MaxPool2d(2, 2)
+        self.flat = nn.Flatten()
+        self.fc1 = nn.Linear(64 * 8 * 8, 256)
+        self.fc2 = nn.Linear(256, 10)
+
+    def forward(self, x):
+        x = torch.relu(self.conv1(x))
+        x = self.pool1(torch.relu(self.conv2(x)))
+        x = self.pool2(torch.relu(self.conv3(x)))
+        return self.fc2(torch.relu(self.fc1(self.flat(x))))
+
+
+def top_level_task():
+    config = ff.FFConfig.from_args()
+    torch.manual_seed(config.seed)
+    model = ff.FFModel(config)
+    t = model.create_tensor([config.batch_size, 3, 32, 32],
+                            ff.DataType.DT_FLOAT)
+    pm = PyTorchModel(CNN())
+    (out,) = pm.torch_to_ff(model, [t])
+    model.softmax(out)
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=config.learning_rate),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY])
+    pm.copy_weights(model)
+    (x_train, y_train), _ = cifar10.load_data(512)
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.astype(np.int32)
+    model.fit(x_train, y_train, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

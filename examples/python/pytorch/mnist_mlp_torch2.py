@@ -1,0 +1,58 @@
+"""MNIST MLP via the serialized-IR round trip: torch_to_file on one side,
+file_to_ff on the other (reference examples/python/pytorch/
+mnist_mlp_torch2.py exercises the same two-process split)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+import torch
+import torch.nn as nn
+
+import flexflow_tpu as ff
+from flexflow_tpu.torch.model import PyTorchModel
+
+import tempfile
+
+from flexflow_tpu.keras.datasets import mnist
+from flexflow_tpu.torch.model import file_to_ff
+
+
+class MLP(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(784, 256)
+        self.fc2 = nn.Linear(256, 10)
+
+    def forward(self, x):
+        return self.fc2(torch.relu(self.fc1(x)))
+
+
+def top_level_task():
+    config = ff.FFConfig.from_args()
+    torch.manual_seed(config.seed)
+    pm = PyTorchModel(MLP())
+    with tempfile.NamedTemporaryFile(suffix=".ir", delete=False) as f:
+        path = f.name
+    pm.torch_to_file(path)
+
+    model = ff.FFModel(config)
+    t = model.create_tensor([config.batch_size, 784], ff.DataType.DT_FLOAT)
+    (out,) = file_to_ff(path, model, [t])
+    model.softmax(out)
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=config.learning_rate),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY])
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 784).astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+    model.fit(x_train, y_train, epochs=config.epochs)
+    _os.unlink(path)
+
+
+if __name__ == "__main__":
+    top_level_task()

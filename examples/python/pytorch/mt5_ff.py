@@ -1,0 +1,61 @@
+"""mT5 encoder-decoder through the HF fx tracer (reference
+examples/python/pytorch/mt5/mt5_ff.py): trace, lower, train.
+
+Uses a randomly-initialized mt5-small-shaped config (the environment has
+no network for checkpoint download); the translation path is identical.
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+import torch
+import torch.nn as nn
+
+import flexflow_tpu as ff
+from flexflow_tpu.torch.model import PyTorchModel
+
+from transformers import MT5Config, MT5ForConditionalGeneration
+
+
+def top_level_task():
+    config = ff.FFConfig.from_args()
+    torch.manual_seed(config.seed)
+    mcfg = MT5Config(vocab_size=512, d_model=64, d_kv=16, d_ff=128,
+                     num_layers=2, num_decoder_layers=2, num_heads=4,
+                     decoder_start_token_id=0, dropout_rate=0.0)
+    hf = MT5ForConditionalGeneration(mcfg)
+    hf.eval()
+
+    B = config.batch_size
+    S_enc, S_dec = 24, 16
+    pm = PyTorchModel(hf, is_hf_model=True, batch_size=B,
+                      input_names=["input_ids", "attention_mask",
+                                   "decoder_input_ids"],
+                      seq_length=(S_enc, S_dec))
+    model = ff.FFModel(config)
+    ins = [model.create_tensor([B, S_enc], ff.DataType.DT_INT32),
+           model.create_tensor([B, S_enc], ff.DataType.DT_INT32),
+           model.create_tensor([B, S_dec], ff.DataType.DT_INT32)]
+    (logits,) = pm.torch_to_ff(model, ins)
+    model.softmax(model.reshape(logits, [B * S_dec, mcfg.vocab_size]))
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=config.learning_rate),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    pm.copy_weights(model)
+
+    rng = np.random.RandomState(config.seed)
+    for step in range(2 * config.epochs):
+        ids = rng.randint(1, 512, size=(B, S_enc)).astype(np.int32)
+        mask = np.ones((B, S_enc), np.int32)
+        dec = rng.randint(1, 512, size=(B, S_dec)).astype(np.int32)
+        labels = rng.randint(0, 512, size=(B * S_dec, 1)).astype(np.int32)
+        loss = model.train_one_batch([ids, mask, dec], labels)
+        print(f"step {step}: loss={loss:.4f}")
+
+
+if __name__ == "__main__":
+    top_level_task()

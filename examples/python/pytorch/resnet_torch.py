@@ -1,0 +1,74 @@
+"""Bottleneck-block ResNet in torch -> FF (reference
+examples/python/pytorch/resnet.py)."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import numpy as np
+import torch
+import torch.nn as nn
+
+import flexflow_tpu as ff
+from flexflow_tpu.torch.model import PyTorchModel
+
+
+class Bottleneck(nn.Module):
+    def __init__(self, c_in, c_mid, stride=1):
+        super().__init__()
+        c_out = 4 * c_mid
+        self.c1 = nn.Conv2d(c_in, c_mid, 1, bias=False)
+        self.c2 = nn.Conv2d(c_mid, c_mid, 3, stride=stride, padding=1,
+                            bias=False)
+        self.c3 = nn.Conv2d(c_mid, c_out, 1, bias=False)
+        self.relu = nn.ReLU()
+        self.proj = (nn.Conv2d(c_in, c_out, 1, stride=stride, bias=False)
+                     if stride != 1 or c_in != c_out else nn.Identity())
+
+    def forward(self, x):
+        y = self.relu(self.c1(x))
+        y = self.relu(self.c2(y))
+        y = self.c3(y)
+        return self.relu(y + self.proj(x))
+
+
+class ResNetTiny(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.stem = nn.Conv2d(3, 16, 3, padding=1, bias=False)
+        self.b1 = Bottleneck(16, 8)
+        self.b2 = Bottleneck(32, 8, stride=2)
+        self.pool = nn.AdaptiveAvgPool2d((1, 1))
+        self.flat = nn.Flatten()
+        self.fc = nn.Linear(32, 10)
+
+    def forward(self, x):
+        x = torch.relu(self.stem(x))
+        x = self.b2(self.b1(x))
+        return self.fc(self.flat(self.pool(x)))
+
+
+def top_level_task():
+    config = ff.FFConfig.from_args()
+    torch.manual_seed(config.seed)
+    model = ff.FFModel(config)
+    t = model.create_tensor([config.batch_size, 3, 32, 32],
+                            ff.DataType.DT_FLOAT)
+    pm = PyTorchModel(ResNetTiny())
+    (out,) = pm.torch_to_ff(model, [t])
+    model.softmax(out)
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=config.learning_rate),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY])
+    pm.copy_weights(model)
+    rng = np.random.RandomState(config.seed)
+    xs = rng.randn(4 * config.batch_size, 3, 32, 32).astype(np.float32)
+    ys = rng.randint(0, 10, size=(4 * config.batch_size, 1)).astype(np.int32)
+    model.fit(xs, ys, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -6,6 +6,7 @@ API, then jit-compile to XLA train/eval/predict steps over the device mesh.
 """
 
 from flexflow_tpu.keras import (
+    backend,
     callbacks,
     datasets,
     initializers,
@@ -21,6 +22,6 @@ from flexflow_tpu.keras import (
 from flexflow_tpu.keras.layers import Input
 from flexflow_tpu.keras.models import Model, Sequential
 
-__all__ = ["callbacks", "datasets", "initializers", "layers", "losses",
+__all__ = ["backend", "callbacks", "datasets", "initializers", "layers", "losses",
            "metrics", "models", "optimizers", "preprocessing", "regularizers", "utils",
            "Input", "Model", "Sequential"]

@@ -1,0 +1,3 @@
+"""reference import path: flexflow.keras.backend.internal"""
+
+from flexflow_tpu.keras.backend import gather, rsqrt, sum  # noqa: F401

@@ -488,3 +488,80 @@ class Concatenate(_Merge):
 
     def build_ff(self, ffmodel, ff_inputs):
         return ffmodel.concat(list(ff_inputs), self.axis, name=self.name)
+
+
+# --- op-layers backing flexflow.keras.backend (reference keras backend
+# internal ops: gather, reduce-sum, rsqrt examples) ---------------------
+class Gather(Layer):
+    """torch.gather semantics along ``axis`` (reference gather example)."""
+
+    def __init__(self, axis: int = 1, name: Optional[str] = None, **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.axis = axis
+
+    def compute_output_shape(self, input_shapes):
+        return tuple(input_shapes[1])
+
+    def build_ff(self, ffmodel, ff_inputs):
+        return ffmodel.gather(ff_inputs[0], ff_inputs[1], self.axis,
+                              name=self.name)
+
+
+class ReduceSum(Layer):
+    def __init__(self, axis, keepdims: bool = False,
+                 name: Optional[str] = None, **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.axes = [axis] if isinstance(axis, int) else list(axis)
+        self.keepdims = keepdims
+
+    def compute_output_shape(self, input_shapes):
+        s = list(input_shapes[0])
+        for a in sorted(self.axes, reverse=True):
+            if self.keepdims:
+                s[a] = 1
+            else:
+                del s[a]
+        return tuple(s)
+
+    def build_ff(self, ffmodel, ff_inputs):
+        return ffmodel.reduce_sum(ff_inputs[0], self.axes,
+                                  keepdims=self.keepdims, name=self.name)
+
+
+class Rsqrt(Layer):
+    def compute_output_shape(self, input_shapes):
+        return tuple(input_shapes[0])
+
+    def build_ff(self, ffmodel, ff_inputs):
+        return ffmodel.rsqrt(ff_inputs[0], name=self.name)
+
+
+# --- functional merge aliases (reference keras.layers.add/subtract/...) --
+def add(inputs, **kwargs):
+    return Add(**kwargs)(inputs)
+
+
+def subtract(inputs, **kwargs):
+    return Subtract(**kwargs)(inputs)
+
+
+def multiply(inputs, **kwargs):
+    return Multiply(**kwargs)(inputs)
+
+
+def maximum(inputs, **kwargs):
+    return Maximum(**kwargs)(inputs)
+
+
+def minimum(inputs, **kwargs):
+    return Minimum(**kwargs)(inputs)
+
+
+def concatenate(inputs, axis: int = 1, **kwargs):
+    return Concatenate(axis=axis, **kwargs)(inputs)
+
+
+# tensor arithmetic sugar (`x + y` in the reference rsqrt example)
+KerasTensor.__add__ = lambda self, other: add([self, other])
+KerasTensor.__sub__ = lambda self, other: subtract([self, other])
+KerasTensor.__mul__ = lambda self, other: multiply([self, other])

@@ -25,6 +25,7 @@ _LOSSES = {
         LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
     "mean_squared_error": LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
     "mse": LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+    "identity": LossType.LOSS_IDENTITY,
 }
 
 _METRICS = {
@@ -93,6 +94,39 @@ class BaseModel:
     @property
     def optimizer(self):
         return self._optimizer
+
+    def __call__(self, inputs):
+        """Use this model as a layer in another functional graph
+        (reference func_cifar10_cnn_nested.py: ``model1(input_tensor)``).
+        The model's layers are REWIRED onto the new input tensors — its
+        own standalone graph is abandoned, matching the reference pattern
+        where nested sub-models are built only to be composed."""
+        ts = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+        if getattr(self, "_nested_used", False):
+            raise NotImplementedError(
+                f"{self.name}: model already composed into another graph — "
+                f"sharing one sub-model across call sites (siamese weight "
+                f"tying) is not supported; build a second sub-model")
+        self._nested_used = True
+        if not self._outputs:
+            self._finalize_graph()
+        if len(ts) != len(self._inputs):
+            raise ValueError(f"{self.name}: expects {len(self._inputs)} "
+                             f"inputs, got {len(ts)}")
+        order = self._topo_layers()
+        mapping = {id(o): n for o, n in zip(self._inputs, ts)}
+        out_ids = [id(o) for o in self._outputs]
+        for layer in order:
+            if isinstance(layer, InputLayer):
+                continue
+            new_ins = [mapping[id(src)] for src in layer.inbound]
+            old_out = layer.outbound
+            layer.inbound, layer.outbound = [], []
+            new_out = layer(new_ins if len(new_ins) > 1 else new_ins[0])
+            for oo in old_out:
+                mapping[id(oo)] = new_out
+        outs = [mapping[i] for i in out_ids]
+        return outs[0] if len(outs) == 1 else outs
 
     def get_layer(self, name: Optional[str] = None,
                   index: Optional[int] = None) -> Layer:

@@ -32,13 +32,24 @@ _SMALL_BATCH = {
     "examples/python/native/transformer.py": ["-e", "1", "-b", "16"],
     "examples/python/native/bert_proxy_native.py": ["-e", "1", "-b", "8"],
     "examples/python/native/candle_uno.py": ["-e", "1", "-b", "16"],
+    "examples/python/pytorch/mt5_ff.py": ["-e", "1", "-b", "4"],
+    "examples/python/pytorch/regnet.py": ["-e", "1", "-b", "8"],
+    "examples/python/pytorch/torch_vision.py": ["-e", "1", "-b", "8"],
+    "examples/python/pytorch/resnet_torch.py": ["-e", "1", "-b", "8"],
+    "examples/python/pytorch/cifar10_cnn_torch.py": ["-e", "1", "-b", "8"],
+    "examples/python/onnx/alexnet_onnx.py": ["-e", "1", "-b", "8"],
+    "examples/python/onnx/resnet_onnx.py": ["-e", "1", "-b", "8"],
+    "examples/python/keras/func_cifar10_cnn_nested.py": ["-e", "1", "-b", "16"],
+    "examples/python/keras/func_cifar10_cnn_net2net.py": ["-e", "1", "-b", "16"],
+    "examples/python/keras/func_cifar10_cnn_concat_model.py": ["-e", "1", "-b", "16"],
+    "examples/python/keras/func_cifar10_cnn_concat_seq_model.py": ["-e", "1", "-b", "16"],
 }
 
 
 def test_example_list_is_complete():
     """Every script under examples/ is in the matrix (glob-driven, so a
     new example is covered automatically; this asserts the glob works)."""
-    assert len(EXAMPLES) >= 27, EXAMPLES
+    assert len(EXAMPLES) >= 55, EXAMPLES
 
 
 @pytest.mark.parametrize("script", EXAMPLES)
