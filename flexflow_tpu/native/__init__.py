@@ -34,7 +34,7 @@ def _sources():
     src = os.path.join(_NATIVE_DIR, "src")
     return [os.path.join(src, f) for f in
             ("bpe_tokenizer.cpp", "batch_scheduler.cpp",
-             "sp_tokenizer.cpp")]
+             "sp_tokenizer.cpp", "graph_builder.cpp")]
 
 
 def _needs_build() -> bool:
@@ -99,6 +99,32 @@ def _declare(lib: ctypes.CDLL):
     lib.ffs_done_tokens.argtypes = [c.c_void_p, c.c_int64, i32p, c.c_int]
     lib.ffs_prompt_len.restype = c.c_int
     lib.ffs_prompt_len.argtypes = [c.c_void_p, c.c_int64]
+
+    ip = c.POINTER(c.c_int)
+    lib.ffgb_create.restype = c.c_void_p
+    lib.ffgb_create.argtypes = []
+    lib.ffgb_destroy.argtypes = [c.c_void_p]
+    for fn, extra in (("ffgb_input", [c.c_int, c.c_char_p]),
+                      ("ffgb_dense", [c.c_int, c.c_int, c.c_int,
+                                      c.c_char_p]),
+                      ("ffgb_conv2d", [c.c_int] * 9 + [c.c_int,
+                                                       c.c_char_p]),
+                      ("ffgb_pool2d", [c.c_int] * 8 + [c.c_char_p]),
+                      ("ffgb_unary", [c.c_int, c.c_char_p, c.c_char_p]),
+                      ("ffgb_binary", [c.c_int, c.c_int, c.c_char_p,
+                                       c.c_char_p]),
+                      ("ffgb_concat", [ip, c.c_int, c.c_int, c.c_char_p]),
+                      ("ffgb_softmax", [c.c_int, c.c_int, c.c_char_p]),
+                      ("ffgb_dropout", [c.c_int, c.c_double, c.c_char_p]),
+                      ("ffgb_embedding", [c.c_int, c.c_int, c.c_int,
+                                          c.c_char_p]),
+                      ("ffgb_reshape", [c.c_int, ip, c.c_int, c.c_char_p]),
+                      ("ffgb_output", [ip, c.c_int]),
+                      ("ffgb_save", [c.c_char_p]),
+                      ("ffgb_serialize", [c.c_char_p, c.c_int])):
+        f = getattr(lib, fn)
+        f.restype = c.c_int
+        f.argtypes = [c.c_void_p] + extra
 
 
 def load_native() -> Optional[ctypes.CDLL]:

@@ -115,6 +115,38 @@ int ffsp_decode(void *handle, const int32_t *ids, int n, char *out,
                 int cap);                    /* returns total bytes */
 int ffsp_piece_to_id(void *handle, const char *piece);
 
+
+/* ---------------- model graph builder ----------------
+ * Reference: the model-builder half of the C ABI (src/c/flexflow_c.cc
+ * flexflow_model_create + per-op wrappers). A C host constructs the graph
+ * and serializes it as the frontend IR (JSON lines); the runtime loads it
+ * with flexflow_tpu.torch.model.file_to_ff and compiles/trains. Node ids
+ * are >= 0; every function returns a negative value on error. */
+void *ffgb_create(void);
+void ffgb_destroy(void *handle);
+int ffgb_input(void *handle, int index, const char *name);
+int ffgb_dense(void *handle, int in, int out_dim, int use_bias,
+               const char *name);
+int ffgb_conv2d(void *handle, int in, int out_channels, int kh, int kw,
+                int sh, int sw, int ph, int pw, int groups, int use_bias,
+                const char *name);
+int ffgb_pool2d(void *handle, int in, int kh, int kw, int sh, int sw,
+                int ph, int pw, int is_max, const char *name);
+int ffgb_unary(void *handle, int in, const char *op, const char *name);
+int ffgb_binary(void *handle, int a, int b, const char *op,
+                const char *name);
+int ffgb_concat(void *handle, const int *ins, int n, int axis,
+                const char *name);
+int ffgb_softmax(void *handle, int in, int axis, const char *name);
+int ffgb_dropout(void *handle, int in, double rate, const char *name);
+int ffgb_embedding(void *handle, int in, int num_entries, int out_dim,
+                   const char *name);
+int ffgb_reshape(void *handle, int in, const int *shape, int ndims,
+                 const char *name);
+int ffgb_output(void *handle, const int *ids, int n);
+int ffgb_save(void *handle, const char *path);
+int ffgb_serialize(void *handle, char *out, int cap);
+
 #ifdef __cplusplus
 }
 #endif

@@ -1,0 +1,268 @@
+// Native C graph-builder ABI: construct a model graph from C and hand it
+// to the Python runtime as the frontend IR (JSON-lines, the same format
+// torch/model.py file_to_ff loads).
+//
+// Role-equivalent of the reference's model-builder C API
+// (src/c/flexflow_c.cc: flexflow_model_create + per-op builder wrappers,
+// the ABI its Python cffi consumed). Here the device runtime is JAX, so
+// the C surface produces the serialized graph instead of wrapping live
+// C++ objects — a C host builds/saves a model; compile/train happens in
+// the runtime (flexflow_tpu.torch.model.file_to_ff -> FFModel.compile).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <set>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Node {
+  std::string op;
+  std::string name;
+  std::vector<std::string> inputs;
+  std::string attrs_json;  // pre-rendered {"k":v,...} WITHOUT braces
+};
+
+struct GraphBuilder {
+  std::vector<Node> nodes;
+  std::set<std::string> names;   // node names ARE edge references: unique
+  int next_id = 0;
+  bool has_output = false;
+
+  std::string fresh(const char *user, const char *op) {
+    if (user && user[0]) return std::string(user);
+    std::ostringstream os;
+    os << op << "_n" << next_id;
+    std::string n = os.str();
+    while (names.count(n)) n += "_";
+    return n;
+  }
+
+  /* returns -1 on duplicate name (silent rewiring otherwise) */
+  int add(const std::string &op, const std::string &name,
+          std::vector<std::string> inputs, const std::string &attrs) {
+    if (!names.insert(name).second) return -1;
+    nodes.push_back(Node{op, name, std::move(inputs), attrs});
+    return next_id++;
+  }
+
+  const std::string &name_of(int id) const { return nodes[id].name; }
+};
+
+std::string json_str(const std::string &s) {
+  std::string out = "\"";
+  char buf[8];
+  for (unsigned char c : s) {
+    if (c == '"' || c == '\\') {
+      out += '\\';
+      out += static_cast<char>(c);
+    } else if (c < 0x20) {           // control chars break JSON lines
+      std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+      out += buf;
+    } else {
+      out += static_cast<char>(c);
+    }
+  }
+  return out + "\"";
+}
+
+GraphBuilder *GB(void *h) { return static_cast<GraphBuilder *>(h); }
+
+bool valid(GraphBuilder *g, int id) {
+  return id >= 0 && id < static_cast<int>(g->nodes.size());
+}
+
+}  // namespace
+
+extern "C" {
+
+void *ffgb_create() { return new GraphBuilder(); }
+
+void ffgb_destroy(void *h) { delete GB(h); }
+
+/* Placeholder bound to the runtime's input_tensors[index]. */
+int ffgb_input(void *h, int index, const char *name) {
+  GraphBuilder *g = GB(h);
+  if (index < 0) return -1;   // python negative indexing would silently
+                              // bind the LAST runtime tensor
+  std::ostringstream a;
+  a << "\"index\": " << index;
+  return g->add("input", g->fresh(name, "input"), {}, a.str());
+}
+
+int ffgb_dense(void *h, int in, int out_dim, int use_bias,
+               const char *name) {
+  GraphBuilder *g = GB(h);
+  if (!valid(g, in)) return -1;
+  std::ostringstream a;
+  a << "\"out_dim\": " << out_dim
+    << ", \"use_bias\": " << (use_bias ? "true" : "false");
+  return g->add("linear", g->fresh(name, "linear"), {g->name_of(in)},
+                a.str());
+}
+
+int ffgb_conv2d(void *h, int in, int out_channels, int kh, int kw, int sh,
+                int sw, int ph, int pw, int groups, int use_bias,
+                const char *name) {
+  GraphBuilder *g = GB(h);
+  if (!valid(g, in)) return -1;
+  std::ostringstream a;
+  a << "\"out_channels\": " << out_channels << ", \"kernel\": [" << kh
+    << ", " << kw << "], \"stride\": [" << sh << ", " << sw
+    << "], \"padding\": [" << ph << ", " << pw << "], \"groups\": " << groups
+    << ", \"use_bias\": " << (use_bias ? "true" : "false");
+  return g->add("conv2d", g->fresh(name, "conv2d"), {g->name_of(in)},
+                a.str());
+}
+
+/* is_max != 0 -> max pooling, else average. */
+int ffgb_pool2d(void *h, int in, int kh, int kw, int sh, int sw, int ph,
+                int pw, int is_max, const char *name) {
+  GraphBuilder *g = GB(h);
+  if (!valid(g, in)) return -1;
+  std::ostringstream a;
+  a << "\"kernel\": [" << kh << ", " << kw << "], \"stride\": [" << sh
+    << ", " << sw << "], \"padding\": [" << ph << ", " << pw
+    << "], \"pool\": " << (is_max ? "\"max\"" : "\"avg\"");
+  return g->add("pool2d", g->fresh(name, "pool2d"), {g->name_of(in)},
+                a.str());
+}
+
+/* op in: relu sigmoid tanh gelu elu identity flat rsqrt */
+int ffgb_unary(void *h, int in, const char *op, const char *name) {
+  GraphBuilder *g = GB(h);
+  if (!valid(g, in)) return -1;
+  static const char *ok[] = {"relu", "sigmoid", "tanh",  "gelu",
+                             "elu",  "identity", "flat", "rsqrt"};
+  bool found = false;
+  for (const char *o : ok) found = found || (std::string(o) == op);
+  if (!found) return -1;
+  return g->add(op, g->fresh(name, op), {g->name_of(in)}, "");
+}
+
+/* op in: add subtract multiply divide max min batch_matmul */
+int ffgb_binary(void *h, int a_id, int b_id, const char *op,
+                const char *name) {
+  GraphBuilder *g = GB(h);
+  if (!valid(g, a_id) || !valid(g, b_id)) return -1;
+  static const char *ok[] = {"add", "subtract", "multiply", "divide",
+                             "max", "min",      "batch_matmul"};
+  bool found = false;
+  for (const char *o : ok) found = found || (std::string(o) == op);
+  if (!found) return -1;
+  return g->add(op, g->fresh(name, op),
+                {g->name_of(a_id), g->name_of(b_id)}, "");
+}
+
+int ffgb_concat(void *h, const int *ins, int n, int axis, const char *name) {
+  GraphBuilder *g = GB(h);
+  std::vector<std::string> names;
+  for (int i = 0; i < n; i++) {
+    if (!valid(g, ins[i])) return -1;
+    names.push_back(g->name_of(ins[i]));
+  }
+  std::ostringstream a;
+  a << "\"axis\": " << axis;
+  return g->add("concat", g->fresh(name, "concat"), std::move(names),
+                a.str());
+}
+
+int ffgb_softmax(void *h, int in, int axis, const char *name) {
+  GraphBuilder *g = GB(h);
+  if (!valid(g, in)) return -1;
+  std::ostringstream a;
+  a << "\"axis\": " << axis;
+  return g->add("softmax", g->fresh(name, "softmax"), {g->name_of(in)},
+                a.str());
+}
+
+int ffgb_dropout(void *h, int in, double rate, const char *name) {
+  GraphBuilder *g = GB(h);
+  if (!valid(g, in)) return -1;
+  std::ostringstream a;
+  a << "\"rate\": " << rate;
+  return g->add("dropout", g->fresh(name, "dropout"), {g->name_of(in)},
+                a.str());
+}
+
+int ffgb_embedding(void *h, int in, int num_entries, int out_dim,
+                   const char *name) {
+  GraphBuilder *g = GB(h);
+  if (!valid(g, in)) return -1;
+  std::ostringstream a;
+  a << "\"num_entries\": " << num_entries << ", \"out_dim\": " << out_dim;
+  return g->add("embedding", g->fresh(name, "embedding"), {g->name_of(in)},
+                a.str());
+}
+
+int ffgb_reshape(void *h, int in, const int *shape, int ndims,
+                 const char *name) {
+  GraphBuilder *g = GB(h);
+  if (!valid(g, in)) return -1;
+  std::ostringstream a;
+  a << "\"shape\": [";
+  for (int i = 0; i < ndims; i++) a << (i ? ", " : "") << shape[i];
+  a << "]";
+  return g->add("reshape", g->fresh(name, "reshape"), {g->name_of(in)},
+                a.str());
+}
+
+/* Mark the graph outputs. Call once, last. Returns 0 on success. */
+int ffgb_output(void *h, const int *ids, int n) {
+  GraphBuilder *g = GB(h);
+  if (g->has_output) return -1;
+  std::vector<std::string> names;
+  for (int i = 0; i < n; i++) {
+    if (!valid(g, ids[i])) return -1;
+    names.push_back(g->name_of(ids[i]));
+  }
+  g->add("output", "output", std::move(names), "");
+  g->has_output = true;
+  return 0;
+}
+
+static std::string to_ir_string(const GraphBuilder *g) {
+  std::ostringstream all;
+  for (const Node &n : g->nodes) {
+    all << "{\"op\": " << json_str(n.op) << ", \"name\": "
+        << json_str(n.name) << ", \"inputs\": [";
+    for (size_t i = 0; i < n.inputs.size(); i++)
+      all << (i ? ", " : "") << json_str(n.inputs[i]);
+    all << "], \"attrs\": {" << n.attrs_json << "}}\n";
+  }
+  return all.str();
+}
+
+/* Serialize to the frontend IR (JSON lines). Returns 0 on success. */
+int ffgb_save(void *h, const char *path) {
+  GraphBuilder *g = GB(h);
+  if (!g->has_output) return -1;
+  FILE *f = std::fopen(path, "w");
+  if (!f) return -2;
+  std::string s = to_ir_string(g);
+  std::fwrite(s.data(), 1, s.size(), f);
+  std::fclose(f);
+  return 0;
+}
+
+/* Serialize into a caller buffer; returns bytes needed (excluding NUL),
+ * negative on error. Writes at most cap bytes. */
+int ffgb_serialize(void *h, char *out, int cap) {
+  GraphBuilder *g = GB(h);
+  if (!g->has_output) return -1;
+  std::string s = to_ir_string(g);
+  if (out && cap > 0) {
+    int ncopy = cap - 1 < static_cast<int>(s.size())
+                    ? cap - 1
+                    : static_cast<int>(s.size());
+    std::memcpy(out, s.data(), ncopy);
+    out[ncopy] = '\0';
+  }
+  return static_cast<int>(s.size());
+}
+
+}  // extern "C"

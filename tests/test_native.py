@@ -8,6 +8,7 @@ import string
 import numpy as np
 import pytest
 
+import flexflow_tpu as ff
 from flexflow_tpu.native import native_available
 from flexflow_tpu.native.tokenizer import (
     BPETokenizer,
@@ -318,3 +319,72 @@ def test_sp_bpe_differs_from_unigram_but_roundtrips():
     text = "the quick brown fox"
     assert uni.decode(uni.encode(text)[1:]) == text
     assert bpe.decode(bpe.encode(text)[1:]) == text
+
+
+# ---------------------------------------------------------------------------
+# Native C graph-builder ABI (reference src/c/flexflow_c.cc model-builder
+# wrappers; here the C host serializes the frontend IR)
+# ---------------------------------------------------------------------------
+def test_native_graph_builder_builds_and_trains():
+    from flexflow_tpu.native.graph_builder import NativeGraphBuilder
+
+    try:
+        gb = NativeGraphBuilder()
+    except RuntimeError:
+        pytest.skip("native toolchain unavailable")
+    x = gb.input(0)
+    h = gb.unary(gb.dense(x, 32, name="fc1"), "relu")
+    h2 = gb.dense(h, 32, name="fc2")
+    s = gb.binary(h, h2, "add")          # residual
+    out = gb.softmax(gb.dense(s, 4, name="head"))
+    gb.output([out])
+
+    model = ff.FFModel(ff.FFConfig(batch_size=8))
+    t = model.create_tensor([8, 16], ff.DataType.DT_FLOAT)
+    outs = gb.build_on(model, [t])
+    assert outs[0].dims == (8, 4)
+    model.compile(optimizer=ff.SGDOptimizer(model, lr=0.05),
+                  loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(8, 16).astype(np.float32)
+    ys = rng.randint(0, 4, size=(8, 1)).astype(np.int32)
+    losses = [model.train_one_batch([xs], ys) for _ in range(4)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]        # separably-fittable random batch
+
+
+def test_native_graph_builder_save_roundtrip(tmp_path):
+    from flexflow_tpu.native.graph_builder import NativeGraphBuilder
+    from flexflow_tpu.torch.model import file_to_ff
+
+    try:
+        gb = NativeGraphBuilder()
+    except RuntimeError:
+        pytest.skip("native toolchain unavailable")
+    x = gb.input(0)
+    c = gb.conv2d(x, 8, 3, 3, 1, 1, 1, 1, name="conv")
+    p = gb.pool2d(gb.unary(c, "relu"), 2, 2, 2, 2)
+    f = gb.unary(p, "flat")
+    out = gb.softmax(gb.dense(f, 10))
+    gb.output([out])
+    path = tmp_path / "cnet.ir"
+    gb.save(str(path))
+
+    model = ff.FFModel(ff.FFConfig(batch_size=4))
+    t = model.create_tensor([4, 3, 8, 8], ff.DataType.DT_FLOAT)
+    outs = file_to_ff(str(path), model, [t])
+    assert outs[0].dims == (4, 10)
+
+
+def test_native_graph_builder_rejects_bad_ids():
+    from flexflow_tpu.native.graph_builder import NativeGraphBuilder
+
+    try:
+        gb = NativeGraphBuilder()
+    except RuntimeError:
+        pytest.skip("native toolchain unavailable")
+    with pytest.raises(ValueError):
+        gb.dense(99, 8)                  # unknown node id
+    x = gb.input(0)
+    with pytest.raises(ValueError):
+        gb.unary(x, "not_an_op")
