@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Build the image and run the full suite on a virtual 8-device mesh
+# (reference docker/run.sh equivalent).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+docker build -f docker/Dockerfile -t flexflow-tpu .
+docker run --rm -e XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    -e JAX_PLATFORMS=cpu flexflow-tpu
