@@ -303,10 +303,11 @@ def _bf16_companion_line():
     import subprocess
 
     try:
+        # hard cap: a wedged child must not starve the int8 headline run
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--small",
              "--no-mfu"],
-            capture_output=True, text=True, timeout=3000)
+            capture_output=True, text=True, timeout=900)
         lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
         if r.returncode == 0 and lines:
             d = json.loads(lines[-1])

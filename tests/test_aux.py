@@ -246,3 +246,25 @@ def test_profiler_trace(tmp_path):
     with profiler_trace(logdir):
         model.predict(x)
     assert os.path.isdir(logdir) and os.listdir(logdir)
+
+
+def test_substitutions_to_dot_tool(tmp_path):
+    """tools/substitutions_to_dot renders the rule set (reference
+    tools/substitutions_to_dot visualizer)."""
+    import runpy
+    import sys
+
+    out = tmp_path / "rules.dot"
+    argv = sys.argv
+    sys.argv = ["substitutions_to_dot.py", "-o", str(out)]
+    try:
+        with pytest.raises(SystemExit) as e:
+            runpy.run_path(os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "tools", "substitutions_to_dot.py"), run_name="__main__")
+        assert e.value.code == 0
+    finally:
+        sys.argv = argv
+    text = out.read_text()
+    assert "digraph substitutions" in text
+    assert "fuse_linear_relu" in text

@@ -1,0 +1,74 @@
+"""Render substitution rules as graphviz dot (reference
+tools/substitutions_to_dot: visualizes the GraphXfer rule set).
+
+Usage:
+  python tools/substitutions_to_dot.py [rules.json] [-o out.dot]
+
+With no argument, renders the built-in rule set
+(flexflow_tpu.search.substitution.builtin_rules). Each rule becomes one
+subgraph cluster with the source pattern on the left, the target pattern
+on the right, and the mapped outputs connecting them.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), os.pardir)))
+
+from flexflow_tpu.search.substitution import builtin_rules, load_rules_json
+
+
+def _pattern_nodes(lines, tag, ops, color):
+    for i, opx in enumerate(ops):
+        label = opx.op_type.name if opx.op_type is not None else "*"
+        if opx.params:
+            label += "\\n" + ",".join(f"{k}={v}"
+                                      for k, v in opx.params.items())
+        lines.append(f'    {tag}{i} [label="{label}", shape=box, '
+                     f'style=filled, fillcolor="{color}"];')
+        for (src_op, _ts) in opx.inputs:
+            if src_op >= 0:
+                lines.append(f"    {tag}{src_op} -> {tag}{i};")
+
+
+def rules_to_dot(rules):
+    lines = ["digraph substitutions {", "  rankdir=LR;",
+             "  compound=true;"]
+    for r_i, rule in enumerate(rules):
+        lines.append(f"  subgraph cluster_{r_i} {{")
+        lines.append(f'    label="{rule.name}";')
+        _pattern_nodes(lines, f"r{r_i}s", rule.src, "#cfe2ff")
+        _pattern_nodes(lines, f"r{r_i}d", rule.dst, "#d1e7dd")
+        for (d_op, _dt, s_op, _st) in rule.mapped_outputs:
+            lines.append(f"    r{r_i}s{s_op} -> r{r_i}d{d_op} "
+                         f"[style=dashed, color=gray, "
+                         f'label="maps", constraint=false];')
+        lines.append("  }")
+    lines.append("}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("rules_json", nargs="?", default=None,
+                    help="reference-format substitution JSON "
+                         "(default: built-in rules)")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output .dot path (default: stdout)")
+    args = ap.parse_args(argv)
+    rules = (load_rules_json(args.rules_json) if args.rules_json
+             else builtin_rules())
+    dot = rules_to_dot(rules)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(dot)
+        print(f"wrote {args.out} ({len(rules)} rules)")
+    else:
+        sys.stdout.write(dot)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
