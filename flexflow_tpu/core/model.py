@@ -754,8 +754,10 @@ class FFModel:
         self._pp_segment_fn = None
 
         # --- Unity-style auto-parallelization (reference model.cc:3327
-        # launches GRAPH_OPTIMIZE_TASK inside compile) ---
-        self.strategy = None
+        # launches GRAPH_OPTIMIZE_TASK inside compile). A strategy the
+        # user assigned BEFORE compile (manual per-op shardings, e.g. a
+        # Strategy.load of an exported search result) is kept: it drives
+        # weight placement at init and the run-graph constraints below.
         if self.config.auto_parallel:
             from flexflow_tpu.search import optimize_model
 

@@ -102,6 +102,29 @@ class CostModel:
         for a in st.partial_axes:
             m.comm_time += self.machine.all_reduce_time(
                 out_bytes, axes.get(a, 1))
+        # spatially-sharded convs exchange (kernel-1) halo rows with both
+        # neighbors every step (GSPMD inserts the collective-permutes);
+        # without this charge conv-sp would look free and dominate dp even
+        # when the halo exceeds the per-shard extent
+        if node.op_type == OpType.CONV2D and node.input_shapes:
+            in_shape = node.input_shapes[0]
+            in_spec = (st.input_specs[0] if st.input_specs
+                       else (None,) * len(in_shape))
+            for d, k_attr in ((2, "kernel_h"), (3, "kernel_w")):
+                if d >= len(in_spec) or in_spec[d] is None:
+                    continue
+                deg = axes.get(in_spec[d], 1)
+                halo = node.attrs.get(k_attr, 1) - 1
+                if deg <= 1 or halo <= 0:
+                    continue
+                halo_shape = list(in_shape)
+                halo_shape[d] = halo
+                spec_wo = list(in_spec) + [None] * (len(in_shape)
+                                                    - len(in_spec))
+                spec_wo[d] = None
+                hb = shard_bytes(tuple(halo_shape), node.dtype_bytes,
+                                 tuple(spec_wo), axes)
+                m.comm_time += 2.0 * self.machine.ppermute_time(hb)
         # gradient sync: weights replicated over "data" ⇒ allreduce of grads
         if self.training and node.weight_shapes:
             data_deg = axes.get("data", 1)

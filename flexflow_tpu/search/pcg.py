@@ -275,6 +275,24 @@ def add_conv_candidates(node: PCGNode, cands: List[OpStrategy],
         cands.append(OpStrategy(
             input_specs=ins, output_spec=tuple(out), weight_specs=wspecs,
             name=f"conv-oc{'+dp' if dax else ''}"))
+        # attribute (spatial) parallelism — the A of SOAP for convs
+        # (reference enable_attribute_parallel): the H dim sharded over
+        # 'model'; GSPMD inserts the halo exchanges. Weights replicated.
+        h_out = node.output_shapes[0][2] if out_nd >= 3 else 0
+        if h_out and node.input_shapes and len(node.input_shapes[0]) >= 3:
+            sp_out = list(_batch(out_nd, dax))
+            sp_out[2] = model
+            sp_ins = []
+            for s in node.input_shapes:
+                spec = list(_batch(len(s), dax))
+                if len(s) >= 3:
+                    spec[2] = model
+                sp_ins.append(tuple(spec))
+            cands.append(OpStrategy(
+                input_specs=tuple(sp_ins), output_spec=tuple(sp_out),
+                weight_specs={w: replicated(len(s))
+                              for w, s in node.weight_shapes.items()},
+                name=f"conv-sp{'+dp' if dax else ''}"))
 
 
 def add_expert_candidates(node: PCGNode, cands: List[OpStrategy],

@@ -729,3 +729,49 @@ def test_nonsequence_split_beats_dp_and_sequence_only_search():
     assert len({bi for (bi, _) in branch_tags}) == 4
     assert s_full.cost < s_seq.cost, (s_full.cost, s_seq.cost)
     assert s_full.cost < dp.cost, (s_full.cost, dp.cost)
+
+
+def test_conv_candidates_cover_soap_dims():
+    """Convs enumerate output-channel (Parameter) and spatial (Attribute)
+    parallel forms next to dp (Sample) — the SOAP dims for conv nets
+    (reference enable_parameter/attribute_parallel, config.h:148-150)."""
+    cfg = ff.FFConfig(batch_size=8)
+    m = ff.FFModel(cfg)
+    t = m.create_tensor([8, 16, 16, 16], ff.DataType.DT_FLOAT)
+    m.conv2d(t, 32, 3, 3, 1, 1, 1, 1)
+    pcg = PCG.from_model(m)
+    names = {c.name for c in pcg.nodes[0].candidates(
+        {"data": 2, "model": 4})}
+    assert {"dp", "conv-oc", "conv-oc+dp", "conv-sp", "conv-sp+dp"} <= names
+
+
+def test_spatially_sharded_conv_trains_on_mesh():
+    """A conv-sp strategy (H dim on 'model') compiles and trains: GSPMD
+    inserts the halo exchanges for the sharding constraint."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from flexflow_tpu.search.strategy import OpStrategy, Strategy
+
+    cfg = ff.FFConfig(batch_size=8, data_parallelism_degree=2,
+                      tensor_parallelism_degree=4)
+    m = ff.FFModel(cfg)
+    t = m.create_tensor([8, 4, 16, 16], ff.DataType.DT_FLOAT)
+    x = m.conv2d(t, 8, 3, 3, 1, 1, 1, 1, ff.ActiMode.AC_MODE_RELU,
+                 name="conv")
+    m.softmax(m.dense(m.flat(x), 4, name="head"))
+    st = Strategy(ops={"conv": OpStrategy(
+        input_specs=(("data", None, "model", None),),
+        output_spec=("data", None, "model", None),
+        weight_specs={"kernel": (None,) * 4, "bias": (None,)},
+        name="conv-sp+dp")})
+    m.strategy = st          # manual strategy survives compile()
+    m.compile(optimizer=ff.SGDOptimizer(m, lr=0.01),
+              loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    assert m.strategy is st
+    rng = np.random.RandomState(0)
+    xs = rng.randn(8, 4, 16, 16).astype(np.float32)
+    ys = rng.randint(0, 4, size=(8, 1)).astype(np.int32)
+    loss = m.train_one_batch([xs], ys)
+    assert np.isfinite(loss)

@@ -18,6 +18,7 @@ sys.path.insert(0, os.path.abspath(os.path.join(
     os.path.dirname(__file__), os.pardir)))
 
 from flexflow_tpu.search.substitution import builtin_rules, load_rules_json
+from flexflow_tpu.utils.dot import _esc
 
 
 def _pattern_nodes(lines, tag, ops, color):
@@ -26,7 +27,7 @@ def _pattern_nodes(lines, tag, ops, color):
         if opx.params:
             label += "\\n" + ",".join(f"{k}={v}"
                                       for k, v in opx.params.items())
-        lines.append(f'    {tag}{i} [label="{label}", shape=box, '
+        lines.append(f'    {tag}{i} [label="{_esc(label)}", shape=box, '
                      f'style=filled, fillcolor="{color}"];')
         for (src_op, _ts) in opx.inputs:
             if src_op >= 0:
@@ -38,7 +39,7 @@ def rules_to_dot(rules):
              "  compound=true;"]
     for r_i, rule in enumerate(rules):
         lines.append(f"  subgraph cluster_{r_i} {{")
-        lines.append(f'    label="{rule.name}";')
+        lines.append(f'    label="{_esc(rule.name)}";')
         _pattern_nodes(lines, f"r{r_i}s", rule.src, "#cfe2ff")
         _pattern_nodes(lines, f"r{r_i}d", rule.dst, "#d1e7dd")
         for (d_op, _dt, s_op, _st) in rule.mapped_outputs:
