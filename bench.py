@@ -325,7 +325,32 @@ def _bf16_companion_line():
         return {"bf16_line": f"error: {e}"}
 
 
+def _arm_watchdog():
+    """A dead device tunnel makes backend init hang FOREVER (observed:
+    jax.devices() never returns while the axon listener is down). The
+    watchdog turns that into a loud, parseable failure instead of eating
+    the caller's whole time budget. FF_TPU_BENCH_WATCHDOG seconds
+    (default 5400 — a hang-stopper, far above any real full-bench run;
+    0 disables)."""
+    import signal
+
+    budget = int(os.environ.get("FF_TPU_BENCH_WATCHDOG", "5400"))
+    if budget <= 0 or not hasattr(signal, "SIGALRM"):
+        return
+
+    def _fire(signum, frame):
+        print(json.dumps({"metric": "specinfer_tokens_per_s", "value": 0,
+                          "unit": "tokens/s", "vs_baseline": 0,
+                          "error": f"bench watchdog fired after {budget}s "
+                                   f"(device backend hung?)"}), flush=True)
+        os._exit(3)
+
+    signal.signal(signal.SIGALRM, _fire)
+    signal.alarm(budget)
+
+
 def main():
+    _arm_watchdog()
     bf16_extra = {}
     if not SMALL and not SMOKE and "--no-bf16-line" not in sys.argv:
         bf16_extra = _bf16_companion_line()
