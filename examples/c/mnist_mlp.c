@@ -1,0 +1,30 @@
+/* MNIST MLP built through the native C graph-builder ABI (reference
+ * examples/cpp entry binaries; here the C host emits the frontend IR and
+ * the Python runtime trains it — run via examples/c/run_mnist_mlp.py).
+ *
+ *   cc mnist_mlp.c -L../../native/build -lflexflow_tpu_native -o mnist_mlp
+ *   ./mnist_mlp model.ir
+ */
+#include <stdio.h>
+
+#include "../../native/include/flexflow_tpu_c.h"
+
+int main(int argc, char **argv) {
+  const char *out = argc > 1 ? argv[1] : "mnist_mlp.ir";
+  void *g = ffgb_create();
+  int x = ffgb_input(g, 0, "images");
+  int h1 = ffgb_unary(g, ffgb_dense(g, x, 256, 1, "fc1"), "relu", NULL);
+  int h2 = ffgb_unary(g, ffgb_dense(g, h1, 128, 1, "fc2"), "relu", NULL);
+  int logits = ffgb_dense(g, h2, 10, 1, "head");
+  int probs = ffgb_softmax(g, logits, -1, NULL);
+  int outs[1];
+  outs[0] = probs;
+  if (ffgb_output(g, outs, 1) != 0 || ffgb_save(g, out) != 0) {
+    fprintf(stderr, "failed to serialize graph\n");
+    ffgb_destroy(g);
+    return 1;
+  }
+  printf("wrote %s\n", out);
+  ffgb_destroy(g);
+  return 0;
+}

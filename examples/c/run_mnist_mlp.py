@@ -1,0 +1,55 @@
+"""Driver for the C-built MNIST MLP: compile the C host, run it to emit
+the IR, load with file_to_ff, train (reference examples/cpp flow where a
+native main owns model construction)."""
+
+import os as _os
+import subprocess as _sp
+import sys as _sys
+import tempfile as _tf
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 2)))
+
+import numpy as np
+
+import flexflow_tpu as ff
+from flexflow_tpu.keras.datasets import mnist
+from flexflow_tpu.torch.model import file_to_ff
+
+_HERE = _os.path.dirname(_os.path.abspath(__file__))
+_ROOT = _os.path.abspath(_os.path.join(_HERE, *[_os.pardir] * 2))
+
+
+def top_level_task():
+    config = ff.FFConfig.from_args()
+    # ensure the native lib exists (lazy g++ build)
+    from flexflow_tpu.native import load_native
+
+    if load_native() is None:
+        raise SystemExit("native toolchain unavailable")
+    with _tf.TemporaryDirectory() as td:
+        exe = _os.path.join(td, "mnist_mlp")
+        ir = _os.path.join(td, "model.ir")
+        _sp.run(["cc", _os.path.join(_HERE, "mnist_mlp.c"),
+                 "-L" + _os.path.join(_ROOT, "native", "build"),
+                 "-lflexflow_tpu_native", "-o", exe], check=True)
+        env = dict(_os.environ)
+        env["LD_LIBRARY_PATH"] = _os.path.join(_ROOT, "native", "build")
+        _sp.run([exe, ir], check=True, env=env)
+
+        model = ff.FFModel(config)
+        t = model.create_tensor([config.batch_size, 784],
+                                ff.DataType.DT_FLOAT)
+        file_to_ff(ir, model, [t])
+    model.compile(
+        optimizer=ff.SGDOptimizer(model, lr=config.learning_rate),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY])
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(-1, 784).astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+    model.fit(x_train, y_train, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

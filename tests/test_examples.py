@@ -19,6 +19,7 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLES = sorted(
     os.path.relpath(p, _ROOT)
     for p in glob.glob(os.path.join(_ROOT, "examples", "python", "*", "*.py"))
+    + glob.glob(os.path.join(_ROOT, "examples", "c", "*.py"))
 )
 
 # every script accepts FFConfig.from_args flags (unknown flags ignored)
