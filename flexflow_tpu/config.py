@@ -72,6 +72,13 @@ class FFConfig:
 
     # --- execution ---
     enable_fusion: bool = True          # XLA fuses; flag kept for parity/tests
+    # serving weight-gemm fusion (qkv, SwiGLU gate|up -> one gemm each;
+    # serve/gemm_fusion.py). Off by default: a 7-vs-4-gemm microbenchmark
+    # wins 11% but the END-TO-END 7B int8 decode step measures 6% SLOWER
+    # fused on v5e (XLA overlaps the separate weight streams with the
+    # Pallas attention call better than one wide gemm) — see the
+    # measurement log in serve/gemm_fusion.py.
+    gemm_fusion: bool = False
     computation_mode: str = "training"
     seed: int = 0
     # numerics: params kept in param_dtype, compute in compute_dtype

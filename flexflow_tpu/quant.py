@@ -92,7 +92,8 @@ def is_quantized(leaf) -> bool:
 
 
 # weights eligible for quantization: the serving matmul weights
-_QUANT_NAMES = {"kernel", "wq", "wk", "wv", "wo", "weight",
+# ("wqkv" = the gemm-fusion concat, serve/gemm_fusion.py)
+_QUANT_NAMES = {"kernel", "wq", "wk", "wv", "wo", "wqkv", "weight",
                 "w1", "w2", "w3", "gate", "up", "down"}
 
 

@@ -196,6 +196,7 @@ class LLM:
         # weights are loaded (reference inference_manager.cc:91-132
         # places layer blocks per stage at model-compile time)
         self.ffmodel.finalize_pipeline()
+        self.ffmodel.finalize_gemm_fusion()
 
         self.rm = RequestManager()
         if self.tokenizer is not None:

@@ -205,8 +205,10 @@ class MultiSpecEngine:
         self.llm = llm
         self.ssms = list(ssms)
         llm.finalize_pipeline()
+        llm.finalize_gemm_fusion()
         for s in self.ssms:
             s.finalize_pipeline()
+            s.finalize_gemm_fusion()
         self.depth = depth
         self.max_rounds = max_rounds
         self._compute_dtype = jnp.dtype(llm.config.compute_dtype)
@@ -478,6 +480,8 @@ class SpecChainEngine:
         self.ssm = ssm
         llm.finalize_pipeline()
         ssm.finalize_pipeline()
+        llm.finalize_gemm_fusion()
+        ssm.finalize_gemm_fusion()
         self.depth = depth
         self.max_rounds = max_rounds
         self._compute_dtype = jnp.dtype(llm.config.compute_dtype)
@@ -628,6 +632,8 @@ class BeamSpecEngine:
         self.ssm = ssm
         llm.finalize_pipeline()
         ssm.finalize_pipeline()
+        llm.finalize_gemm_fusion()
+        ssm.finalize_gemm_fusion()
         self.depth = depth
         self.width = width
         self.max_rounds = max_rounds

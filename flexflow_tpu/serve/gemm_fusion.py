@@ -1,0 +1,302 @@
+"""Serving gemm fusion: fewer, wider decode gemms.
+
+The runtime-fusion counterpart of the reference's FusedOp (reference
+src/runtime/model.cc:2864 ``apply_fusion``, src/ops/fused.cc — packs
+consecutive same-machine-view ops into one task to cut per-op launch
+overhead, enabled by ``--fusion``). On TPU, XLA already fuses elementwise
+work into the gemms, but each *gemm* is still its own MXU pass; at decode
+token widths (M = requests x decode_width <= 64) every pass is
+weight-load bound, so per-gemm fixed cost is paid from the HBM-critical
+path. Measured on one v5e chip (tools/profile_gemmfuse.py, 7B int8
+geometry, M=64): a decoder layer as 7 gemms runs 441 us vs 393 us as 4
+gemms — fusing wq|wk|wv into one [E, (H+2KH)*D] projection and
+gate|up into one [E, 2I] projection recovers ~11% in isolation.
+
+**Measured END-TO-END, fusion loses**: the full 32-layer int8 decode
+block steps 11.09 ms unfused vs 11.78 ms fused on the same chip (A/B in
+one process, readback-fenced, best of 3x96 steps). With the Pallas
+attention call between the projections, XLA's scheduler evidently
+prefetches the separate wk/wv/gate/up weight streams under other work,
+and the single wide gemm forfeits that overlap. The pass therefore
+defaults OFF (``FFConfig.gemm_fusion = False``) and is kept as an
+explicitly-enabled capability — the measurement protocol lives in
+tools/profile_decode.py / profile_gemmfuse.py for re-evaluation on other
+chips or geometries.
+
+Like the reference's FusedOp (which only packs ops sharing a machine
+view), fusion applies on the single-(model-)shard serving path:
+
+* inference compile, no pipeline plan, model mesh axis degree 1
+  (TP shards would need interleaved column order to keep silu(gate)*up
+  shard-local — per-shard gemms are smaller and already less
+  overhead-bound, so fusion is simply skipped);
+* no cpu_offload (fused leaves would break per-weight paging);
+* no inference_debugging (per-op dumps mirror the reference's separate
+  q/k/v tensors).
+
+Applied AFTER weight loading (LLM.compile / InferenceManager init call
+``FFModel.finalize_gemm_fusion``, same deferral pattern as
+finalize_pipeline), so HF checkpoint maps keep writing the separate
+wq/wk/wv/gate/up names and the params dict is rewritten in place:
+
+* attention layers: wq|wk|wv -> "wqkv" (biases -> "bqkv"); the qkv
+  projection in ops/inc_attention._qkv runs one gemm and slices.
+* SwiGLU MLPs: the (gate_proj, up_proj) Linear pair feeding a
+  SigmoidSiluMulti collapses into ONE Linear named
+  "<gate>|<up-leaf>" producing [..., 2I]; the SigmoidSiluMulti gets
+  ``packed=True`` and splits halves internally. Only rewritten when both
+  Linears are bias-free, activation-free, share the input tensor, and
+  the SigmoidSiluMulti is the SOLE consumer of both outputs.
+
+Quantized weights concatenate exactly: the per-column int8/int4 scheme
+(quant.py) keeps one scale per output column, and column concatenation
+preserves each column's payload and scale bit-for-bit. Measured on the
+chip, prefill logits are BIT-IDENTICAL fused vs unfused; at decode
+widths the wider-N gemm can tile differently, so bf16 argmax near-ties
+may resolve differently than the unfused program (the same benign class
+as wide-vs-narrow decode, see inference_manager decode_width). Fused
+incr and fused spec decoding remain token-identical to each other — the
+CI gate compares like with like.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from flexflow_tpu.ffconst import ActiMode, CompMode, OpType
+
+_ATTN_TYPES = (OpType.INC_MULTIHEAD_SELF_ATTENTION,
+               OpType.SPEC_INC_MULTIHEAD_SELF_ATTENTION,
+               OpType.TREE_INC_MULTIHEAD_SELF_ATTENTION)
+
+
+def fusion_eligible(model) -> bool:
+    cfg = model.config
+    return (cfg.enable_fusion
+            and getattr(cfg, "gemm_fusion", False)
+            and getattr(model, "comp_mode", None)
+            == CompMode.COMP_MODE_INFERENCE
+            and model._pp_plan is None
+            and not cfg.cpu_offload
+            and not cfg.inference_debugging
+            and model.mesh is not None
+            and model.mesh.shape.get("model", 1) == 1)
+
+
+def _concat_cols(leaves: List):
+    """Column-concat plain or quantized 2-D weights; None if mixed."""
+    from flexflow_tpu.quant import QuantizedWeight, is_quantized
+
+    if all(is_quantized(w) for w in leaves):
+        qt = {w.qtype for w in leaves}
+        rows = {w.rows for w in leaves}
+        dt = {w.dtype for w in leaves}
+        if len(qt) != 1 or len(rows) != 1 or len(dt) != 1:
+            return None
+        return QuantizedWeight(
+            leaves[0].qtype,
+            jnp.concatenate([w.q for w in leaves], axis=1),
+            jnp.concatenate([w.scale for w in leaves]),
+            leaves[0].rows, leaves[0].dtype)
+    if any(is_quantized(w) for w in leaves):
+        return None
+    if len({w.dtype for w in leaves}) != 1:
+        return None
+    return jnp.concatenate([jnp.asarray(w) for w in leaves], axis=1)
+
+
+def _fuse_attention_qkv(model) -> int:
+    n = 0
+    for layer in model.layers:
+        if layer.op_type not in _ATTN_TYPES:
+            continue
+        lp = model.params.get(layer.name)
+        if not lp or not all(k in lp for k in ("wq", "wk", "wv")):
+            continue
+        fused = _concat_cols([lp["wq"], lp["wk"], lp["wv"]])
+        if fused is None:
+            continue
+        if all(k in lp for k in ("bq", "bk", "bv")):
+            lp["bqkv"] = jnp.concatenate(
+                [jnp.asarray(lp[k]) for k in ("bq", "bk", "bv")])
+            for k in ("bq", "bk", "bv"):
+                del lp[k]
+        lp["wqkv"] = fused
+        for k in ("wq", "wk", "wv"):
+            del lp[k]
+        n += 1
+    return n
+
+
+def _sole_consumer(model, tensor) -> Optional[object]:
+    """The single layer consuming ``tensor``, or None if 0 / >1 / it is
+    the graph's final or logits tensor."""
+    if tensor in (model._final_tensor, model._logits_tensor):
+        return None
+    hits = [ly for ly in model.layers
+            if any(t.tensor_id == tensor.tensor_id for t in ly.inputs)]
+    if len(hits) == 1 and hits[0].inputs.count(tensor) == 1:
+        return hits[0]
+    return None
+
+
+def _fusable_gate_up(model, ssm):
+    """(gate_layer, up_layer) for a fusable SwiGLU pair, else None."""
+    if len(ssm.inputs) != 2 or ssm.attrs.get("packed"):
+        return None
+    prod = {}
+    for ly in model.layers:
+        for t in ly.outputs:
+            prod[t.tensor_id] = ly
+    g, u = (prod.get(t.tensor_id) for t in ssm.inputs)
+    if g is None or u is None or g is u:
+        return None
+    for ly in (g, u):
+        if (ly.op_type != OpType.LINEAR
+                or ly.attrs.get("use_bias", True)
+                or ly.attrs.get("activation",
+                                ActiMode.AC_MODE_NONE)
+                != ActiMode.AC_MODE_NONE
+                or ly.attrs.get("keep_f32_logits")
+                or len(ly.outputs) != 1
+                or set(model.params.get(ly.name, {})) != {"kernel"}):
+            return None
+    if g.inputs[0].tensor_id != u.inputs[0].tensor_id:
+        return None
+    if _sole_consumer(model, g.outputs[0]) is not ssm:
+        return None
+    if _sole_consumer(model, u.outputs[0]) is not ssm:
+        return None
+    return g, u
+
+
+def _fuse_swiglu_mlps(model) -> int:
+    n = 0
+    for ssm in list(model.layers):
+        if ssm.op_type != OpType.SIGMOID_SILU_MULTI:
+            continue
+        pair = _fusable_gate_up(model, ssm)
+        if pair is None:
+            continue
+        g, u = pair
+        fused = _concat_cols([model.params[g.name]["kernel"],
+                              model.params[u.name]["kernel"]])
+        if fused is None:
+            continue
+        new_name = f"{g.name}|{u.name.rsplit('.', 1)[-1]}"
+        old_g, old_u = g.name, u.name
+        g.name = new_name
+        # record the PRE-fusion layer names so the parameter accessors
+        # can resolve them without re-deriving from string surgery
+        g.attrs["fused_gate_layer"] = old_g
+        g.attrs["fused_up_layer"] = old_u
+        g.attrs["out_dim"] = 2 * g.attrs["out_dim"]
+        # keep the WeightSpec consistent with the rewritten graph: a
+        # recompile re-initializes params from these specs, and a stale
+        # (E, I) kernel under a packed SigmoidSiluMulti would crash
+        import dataclasses
+
+        g.weights = [dataclasses.replace(
+            w, shape=(w.shape[0], 2 * w.shape[1])) if w.name == "kernel"
+            else w for w in g.weights]
+        out = g.outputs[0]
+        out.dims = tuple(out.dims[:-1]) + (2 * out.dims[-1],)
+        model.params[new_name] = {"kernel": fused}
+        del model.params[old_g]
+        del model.params[old_u]
+        model.layers.remove(u)
+        ssm.inputs = [out]
+        ssm.attrs["packed"] = True
+        n += 1
+    return n
+
+
+def apply_gemm_fusion(model) -> dict:
+    """Rewrite ``model`` in place; returns {"qkv": n, "swiglu": n}."""
+    return {"qkv": _fuse_attention_qkv(model),
+            "swiglu": _fuse_swiglu_mlps(model)}
+
+
+# ----------------------------------------------------------------------
+# Accessor fallbacks: get/set_parameter_by_key keep working on the
+# PRE-fusion names (wq/wk/wv, gate_proj/up_proj) by slicing/splicing the
+# fused leaf, mirroring pipeline_plan.stacked_param_lookup's role for
+# stage-stacked params.
+# ----------------------------------------------------------------------
+
+def _qkv_slices(layer):
+    hd = layer.attrs["num_q_heads"] * layer.attrs["head_dim"]
+    khd = layer.attrs["num_kv_heads"] * layer.attrs["head_dim"]
+    return {"wq": (0, hd), "wk": (hd, hd + khd), "wv": (hd + khd,
+                                                        hd + 2 * khd),
+            "bq": (0, hd), "bk": (hd, hd + khd), "bv": (hd + khd,
+                                                        hd + 2 * khd)}
+
+
+def _fused_site(model, layer_name: str, weight_name: str):
+    """(params_layer_name, fused_weight_name, col_lo, col_hi) for a
+    pre-fusion key now living inside a fused leaf, else None."""
+    if weight_name in ("wq", "wk", "wv", "bq", "bk", "bv"):
+        for layer in model.layers:
+            if layer.name == layer_name and layer.op_type in _ATTN_TYPES:
+                lp = model.params.get(layer_name, {})
+                fname = "wqkv" if weight_name.startswith("w") else "bqkv"
+                if fname in lp:
+                    lo, hi = _qkv_slices(layer)[weight_name]
+                    return layer_name, fname, lo, hi
+    if weight_name == "kernel":
+        for layer in model.layers:
+            if (layer.op_type != OpType.LINEAR
+                    or "fused_gate_layer" not in layer.attrs):
+                continue
+            half = layer.attrs["out_dim"] // 2
+            if layer_name == layer.attrs["fused_gate_layer"]:
+                return layer.name, "kernel", 0, half
+            if layer_name == layer.attrs["fused_up_layer"]:
+                return layer.name, "kernel", half, 2 * half
+    return None
+
+
+def fused_param_get(model, layer_name: str, weight_name: str):
+    """Dequantized numpy view of a pre-fusion weight, or None."""
+    import numpy as np
+
+    from flexflow_tpu.quant import dequantize_array, is_quantized
+
+    site = _fused_site(model, layer_name, weight_name)
+    if site is None:
+        return None
+    pname, fname, lo, hi = site
+    leaf = model.params[pname][fname]
+    arr = dequantize_array(leaf) if is_quantized(leaf) else jnp.asarray(leaf)
+    return np.asarray(arr[..., lo:hi])
+
+
+def fused_param_set(model, layer_name: str, weight_name: str, value) -> bool:
+    """Write a pre-fusion weight into its fused leaf. Quantized leaves
+    re-quantize the touched columns only (the per-column scheme keeps
+    every other column bit-identical). Returns False if not a fused key."""
+    from flexflow_tpu.quant import QuantizedWeight, is_quantized, \
+        quantize_array
+
+    site = _fused_site(model, layer_name, weight_name)
+    if site is None:
+        return False
+    pname, fname, lo, hi = site
+    leaf = model.params[pname][fname]
+    if is_quantized(leaf):
+        arr = jnp.asarray(value, dtype=jnp.dtype(leaf.dtype))
+        assert arr.shape == (leaf.rows, hi - lo), (arr.shape, leaf.rows,
+                                                   hi - lo)
+        new = quantize_array(arr, leaf.qtype)
+        model.params[pname][fname] = QuantizedWeight(
+            leaf.qtype, leaf.q.at[:, lo:hi].set(new.q),
+            leaf.scale.at[lo:hi].set(new.scale), leaf.rows, leaf.dtype)
+    else:
+        arr = jnp.asarray(value, dtype=leaf.dtype)
+        expect = leaf[..., lo:hi].shape
+        assert arr.shape == expect, (arr.shape, expect)
+        model.params[pname][fname] = leaf.at[..., lo:hi].set(arr)
+    return True

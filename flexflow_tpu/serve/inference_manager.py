@@ -29,6 +29,7 @@ class InferenceManager:
     def __init__(self, model):
         self.model = model
         model.finalize_pipeline()   # no-op unless a pipeline plan is pending
+        model.finalize_gemm_fusion()  # serving gemm fusion (see gemm_fusion.py)
         if model._pp_plan is not None and model.config.inference_debugging:
             raise NotImplementedError(
                 "inference_debugging dumps need per-layer params; not "

@@ -1,0 +1,267 @@
+"""Serving gemm fusion (serve/gemm_fusion.py): the reference's
+--fusion/FusedOp analog (model.cc:2864 apply_fusion). Fused qkv +
+SwiGLU gate|up gemms must be a pure program transformation — token
+outputs identical to the unfused graph — and must refuse unsafe graphs.
+"""
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.ffconst import CompMode, InferenceMode, OpType
+from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+from flexflow_tpu.serve.request_manager import RequestManager
+
+PROMPT = [5, 9, 23, 7]
+
+
+def _build_llama(quant=None, fusion=True, gqa=True, mode=None):
+    cfg = ff.FFConfig(max_requests_per_batch=2, max_sequence_length=64,
+                      max_tokens_per_batch=16, kv_cache_dtype="float32",
+                      quantization_type=quant, enable_fusion=fusion,
+                      gemm_fusion=fusion, seed=3)
+    m = ff.FFModel(cfg)
+    create_llama_model(
+        m,
+        LLAMAConfig(vocab_size=128, hidden_size=128, intermediate_size=96,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2 if gqa else 4,
+                    max_position_embeddings=64),
+        mode or InferenceMode.INC_DECODING_MODE)
+    m.compile(comp_mode=CompMode.COMP_MODE_INFERENCE)
+    return m
+
+
+def _gen(m):
+    rm = RequestManager()
+    rm.register_new_request(list(PROMPT), max_new_tokens=6)
+    res = rm.generate_incr_decoding(m)
+    return res[0].output_tokens
+
+
+@pytest.mark.parametrize("quant", [None, "int8"])
+def test_fused_tokens_match_unfused(quant):
+    base = _gen(_build_llama(quant=quant, fusion=False))
+    m = _build_llama(quant=quant, fusion=True)
+    fused = _gen(m)                   # InferenceManager applies fusion
+    assert fused == base
+    lp = m.params["layers.0.self_attn"]
+    assert "wqkv" in lp and "wq" not in lp
+    names = [ly.name for ly in m.layers]
+    assert "layers.0.mlp.gate_proj|up_proj" in names
+    assert "layers.0.mlp.gate_proj" not in m.params
+    assert "layers.0.mlp.up_proj" not in m.params
+    ssm = [ly for ly in m.layers
+           if ly.op_type == OpType.SIGMOID_SILU_MULTI][0]
+    assert ssm.attrs.get("packed") and len(ssm.inputs) == 1
+
+
+def test_fusion_respects_enable_fusion_flag():
+    m = _build_llama(fusion=False)
+    _gen(m)
+    assert "wq" in m.params["layers.0.self_attn"]
+    assert "layers.0.mlp.gate_proj" in m.params
+
+
+def test_gqa_slicing_matches_mha():
+    """Fused qkv slices must honor KH != H widths."""
+    base = _gen(_build_llama(fusion=False, gqa=True))
+    assert _gen(_build_llama(fusion=True, gqa=True)) == base
+
+
+def test_qkv_bias_concat():
+    """Attention with projection biases (OPT/MPT/StarCoder-style) fuses
+    the biases too and still matches the unfused run."""
+    from flexflow_tpu.models.opt import OPTConfig, create_opt_model
+
+    def build(fusion):
+        cfg = ff.FFConfig(max_requests_per_batch=2, max_sequence_length=64,
+                          max_tokens_per_batch=16, kv_cache_dtype="float32",
+                          enable_fusion=fusion, gemm_fusion=fusion, seed=5)
+        m = ff.FFModel(cfg)
+        create_opt_model(
+            m,
+            OPTConfig(vocab_size=128, hidden_size=64, ffn_dim=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      max_position_embeddings=64, word_embed_proj_dim=64),
+            InferenceMode.INC_DECODING_MODE)
+        m.compile(comp_mode=CompMode.COMP_MODE_INFERENCE)
+        return m
+
+    base = _gen(build(False))
+    m = build(True)
+    assert _gen(m) == base
+    lp = m.params["layers.0.self_attn"]
+    assert "bqkv" in lp and "bq" not in lp
+
+
+def test_swiglu_fusion_skips_shared_gate_output():
+    """If the gate tensor has a second consumer, the MLP pair must NOT
+    fuse (the rewrite would orphan that consumer's input)."""
+    cfg = ff.FFConfig(enable_fusion=True, gemm_fusion=True, seed=0)
+    m = ff.FFModel(cfg)
+    t = m.create_tensor([2, 8], ff.DataType.DT_FLOAT)
+    g = m.dense(t, 8, use_bias=False, name="gate")
+    u = m.dense(t, 8, use_bias=False, name="up")
+    s = m.sigmoid_silu_multi(g, u)
+    m.add(s, g)                       # second consumer of the gate output
+    m.compile(comp_mode=CompMode.COMP_MODE_INFERENCE)
+    m.finalize_gemm_fusion()
+    assert "gate" in m.params and "up" in m.params
+
+
+def test_fusion_skipped_under_tp():
+    """model-axis degree > 1: per-shard gemms keep separate weights."""
+    cfg = ff.FFConfig(max_requests_per_batch=2, max_sequence_length=64,
+                      max_tokens_per_batch=16, kv_cache_dtype="float32",
+                      tensor_parallelism_degree=2, enable_fusion=True,
+                      gemm_fusion=True, seed=3)
+    m = ff.FFModel(cfg)
+    create_llama_model(
+        m,
+        LLAMAConfig(vocab_size=128, hidden_size=64, intermediate_size=96,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, max_position_embeddings=64),
+        InferenceMode.INC_DECODING_MODE)
+    m.compile(comp_mode=CompMode.COMP_MODE_INFERENCE)
+    m.finalize_gemm_fusion()
+    assert "wq" in m.params["layers.0.self_attn"]
+
+
+def test_spec_infer_fused_matches_incr():
+    """The spec engines fuse llm+ssm consistently; spec output still
+    token-matches incremental decoding."""
+    incr = _gen(_build_llama(fusion=True,
+                             mode=InferenceMode.TREE_VERIFY_MODE))
+    llm = _build_llama(fusion=True, mode=InferenceMode.TREE_VERIFY_MODE)
+    ssm = _build_llama(fusion=True, mode=InferenceMode.BEAM_SEARCH_MODE)
+    rm = RequestManager()
+    rm.register_new_request(list(PROMPT), max_new_tokens=6)
+    res = rm.generate_spec_infer(llm, [ssm], spec_depth=3)
+    assert res[0].output_tokens == incr
+
+
+@pytest.mark.parametrize("quant", [None, "int8"])
+def test_fused_param_accessors_roundtrip(quant):
+    """get/set_parameter_by_key keep serving the PRE-fusion names by
+    slicing/splicing the fused leaves (quantized leaves re-quantize only
+    the touched columns)."""
+    m = _build_llama(quant=quant, fusion=True)
+    _gen(m)                                   # applies fusion
+    akey = ("layers.0.self_attn", "wq")
+    w = m.get_parameter_by_key(akey)
+    assert w.shape == (128, 128)
+    wk_before = m.get_parameter_by_key(("layers.0.self_attn", "wk"))
+    new = np.full_like(w, 0.01)
+    m.set_parameter_by_key(akey, new)
+    tol = dict(rtol=0.02, atol=1e-4) if quant else dict(rtol=1e-6)
+    np.testing.assert_allclose(m.get_parameter_by_key(akey), new, **tol)
+    np.testing.assert_allclose(                # neighbors untouched
+        m.get_parameter_by_key(("layers.0.self_attn", "wk")), wk_before,
+        rtol=1e-6)
+    gkey = ("layers.0.mlp.gate_proj", "kernel")
+    g = m.get_parameter_by_key(gkey)
+    assert g.shape == (128, 96)
+    up_before = m.get_parameter_by_key(("layers.0.mlp.up_proj", "kernel"))
+    m.set_parameter_by_key(gkey, np.full_like(g, 0.02))
+    np.testing.assert_allclose(m.get_parameter_by_key(gkey),
+                               np.full_like(g, 0.02), **tol)
+    np.testing.assert_allclose(
+        m.get_parameter_by_key(("layers.0.mlp.up_proj", "kernel")),
+        up_before, rtol=1e-6)
+
+
+def test_finalize_before_compile_does_not_latch():
+    cfg = ff.FFConfig(max_requests_per_batch=2, max_sequence_length=64,
+                      max_tokens_per_batch=16, kv_cache_dtype="float32",
+                      enable_fusion=True, gemm_fusion=True, seed=3)
+    m = ff.FFModel(cfg)
+    create_llama_model(
+        m,
+        LLAMAConfig(vocab_size=128, hidden_size=128, intermediate_size=96,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, max_position_embeddings=64),
+        InferenceMode.INC_DECODING_MODE)
+    m.finalize_gemm_fusion()                  # pre-compile: must not latch
+    m.compile(comp_mode=CompMode.COMP_MODE_INFERENCE)
+    m.finalize_gemm_fusion()
+    assert "wqkv" in m.params["layers.0.self_attn"]
+
+
+def test_gemm_fusion_defaults_off():
+    """gemm_fusion is an explicit opt-in (measured net-negative on the
+    v5e decode end-to-end; see serve/gemm_fusion.py)."""
+    cfg = ff.FFConfig(max_requests_per_batch=2, max_sequence_length=64,
+                      max_tokens_per_batch=16, kv_cache_dtype="float32",
+                      seed=3)
+    assert cfg.enable_fusion and not cfg.gemm_fusion
+    m = ff.FFModel(cfg)
+    create_llama_model(
+        m,
+        LLAMAConfig(vocab_size=128, hidden_size=128, intermediate_size=96,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, max_position_embeddings=64),
+        InferenceMode.INC_DECODING_MODE)
+    m.compile(comp_mode=CompMode.COMP_MODE_INFERENCE)
+    m.finalize_gemm_fusion()
+    assert "wq" in m.params["layers.0.self_attn"]
+
+
+def test_enable_fusion_false_gates_gemm_fusion():
+    """enable_fusion=False must gate the pass even with gemm_fusion=True
+    (the reference --no-fusion flag disables all runtime fusion)."""
+    cfg = ff.FFConfig(max_requests_per_batch=2, max_sequence_length=64,
+                      max_tokens_per_batch=16, kv_cache_dtype="float32",
+                      enable_fusion=False, gemm_fusion=True, seed=3)
+    m = ff.FFModel(cfg)
+    create_llama_model(
+        m,
+        LLAMAConfig(vocab_size=128, hidden_size=128, intermediate_size=96,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, max_position_embeddings=64),
+        InferenceMode.INC_DECODING_MODE)
+    m.compile(comp_mode=CompMode.COMP_MODE_INFERENCE)
+    m.finalize_gemm_fusion()
+    assert "wq" in m.params["layers.0.self_attn"]
+
+
+def test_fused_accessors_on_undotted_names():
+    """Accessor fallback resolves PRE-fusion names via the recorded
+    attrs, including layers whose names have no dotted parent."""
+    cfg = ff.FFConfig(enable_fusion=True, gemm_fusion=True, seed=0)
+    m = ff.FFModel(cfg)
+    t = m.create_tensor([2, 64], ff.DataType.DT_FLOAT)
+    g = m.dense(t, 64, use_bias=False, name="gate")
+    u = m.dense(t, 64, use_bias=False, name="up")
+    s = m.sigmoid_silu_multi(g, u)
+    m.softmax(m.dense(s, 8, use_bias=False))
+    m.compile(comp_mode=CompMode.COMP_MODE_INFERENCE)
+    m.finalize_gemm_fusion()
+    assert "gate" not in m.params and "gate|up" in m.params
+    w = m.get_parameter_by_key(("up", "kernel"))
+    assert w.shape == (64, 64)
+    new = np.full_like(w, 0.03)
+    m.set_parameter_by_key(("up", "kernel"), new)
+    np.testing.assert_allclose(m.get_parameter_by_key(("up", "kernel")),
+                               new, rtol=1e-6)
+
+
+def test_recompile_after_fusion_is_consistent():
+    """compile() is re-runnable: after fusion rewrote the graph, the
+    updated WeightSpecs must re-init a (E, 2I) fused kernel matching the
+    packed SigmoidSiluMulti, and generation must still run."""
+    m = _build_llama(fusion=True)
+    _gen(m)                                   # applies fusion
+    m.compile(comp_mode=CompMode.COMP_MODE_INFERENCE)   # re-init params
+    fused_name = "layers.0.mlp.gate_proj|up_proj"
+    assert m.params[fused_name]["kernel"].shape == (128, 192)
+    out = _gen(m)                             # fresh random weights: just
+    assert len(out) == 6                      # must run, not match
+
+
+def test_fused_param_set_rejects_wrong_shape():
+    m = _build_llama(fusion=True)
+    _gen(m)
+    with pytest.raises(AssertionError):
+        m.set_parameter_by_key(("layers.0.self_attn", "wq"),
+                               np.zeros(128, np.float32))

@@ -1,0 +1,110 @@
+"""Op-level TPU trace profile via jax.profiler.ProfileData.
+
+Captures a few training steps (the profile_resnet.py NCHW variant — the
+shipped bench_train configuration's math) under jax.profiler.trace and
+aggregates per-op device time from the xplane, printing the top ops by
+total duration. Answers "where do the ms go" without guessing from
+ablations.
+
+Usage: python tools/profile_trace.py [resnet|decode]
+"""
+
+import glob
+import os
+import sys
+import time
+from collections import defaultdict
+
+import numpy as np
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "tools")
+
+
+def aggregate(trace_dir, steps=3, min_pct=0.5):
+    """Aggregate the device plane's "XLA Ops" line: per-op kind totals
+    (fusion-name prefixes) + top individual ops, per step."""
+    import re
+
+    import jax.profiler as jp
+
+    files = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                      recursive=True)
+    assert files, f"no xplane under {trace_dir}"
+    pd = jp.ProfileData.from_file(max(files, key=os.path.getmtime))
+    totals = defaultdict(float)
+    counts = defaultdict(int)
+    kinds = defaultdict(float)
+    for plane in pd.planes:
+        if not plane.name.startswith("/device:TPU"):
+            continue
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            for ev in line.events:
+                ms = ev.duration_ns / 1e6
+                totals[ev.name] += ms
+                counts[ev.name] += 1
+                kinds[re.sub(r"[.\d]+$", "", ev.name)
+                      .split("(")[0].split(" = ")[0]] += ms
+    if not totals:
+        print("no device XLA Ops captured (tracing unsupported here?)")
+        return
+    grand = sum(totals.values())
+    print(f"device op total {grand:.1f} ms over {steps} steps -> "
+          f"{grand / steps:.1f} ms/step")
+    print("== by kind ==")
+    for k, ms in sorted(kinds.items(), key=lambda kv: -kv[1])[:15]:
+        if 100 * ms / grand < min_pct:
+            break
+        print(f"{ms / steps:9.2f} ms/step {100 * ms / grand:5.1f}%  {k}")
+    print("== top individual ops ==")
+    for n, ms in sorted(totals.items(), key=lambda kv: -kv[1])[:20]:
+        if 100 * ms / grand < min_pct:
+            break
+        print(f"{ms / steps:8.2f} ms/step {100 * ms / grand:5.1f}% "
+              f"x{counts[n] // steps:3d}  {n[:100]}")
+
+
+def run_resnet(trace_dir):
+    import jax
+
+    from profile_resnet import BATCH, IMG, init_params, make_step
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    params, _ = init_params(rng, nhwc=False)
+    params = jax.tree.map(jnp.asarray, params)
+    x = jnp.asarray(rng.standard_normal((BATCH, 3, IMG, IMG)), jnp.bfloat16)
+    y = jnp.asarray(rng.integers(0, 1000, (BATCH, 1)), jnp.int32)
+    step = make_step(False, True, False)
+    loss, params = step(params, x, y)
+    loss, params = step(params, x, y)
+    float(loss)
+    with jax.profiler.trace(trace_dir):
+        for _ in range(3):
+            loss, params = step(params, x, y)
+        float(loss)
+
+
+def run_decode(trace_dir):
+    import jax
+
+    import bench
+    from profile_decode import build
+
+    m, ifm = build(bench.LAYERS, bench)
+    R, P = bench.NUM_REQUESTS, bench.PROMPT_LEN
+    tok = np.ones((R,), np.int32)
+    pos = np.full((R,), P, np.int32)
+    act = np.ones((R,), bool)
+    np.asarray(ifm.decode_block(tok, pos, act, 4))
+    with jax.profiler.trace(trace_dir):
+        np.asarray(ifm.decode_block(tok, pos, act, 32))
+
+
+if __name__ == "__main__":
+    what = sys.argv[1] if len(sys.argv) > 1 else "resnet"
+    trace_dir = f"/tmp/fftrace_{what}_{int(time.time())}"
+    (run_decode if what == "decode" else run_resnet)(trace_dir)
+    aggregate(trace_dir, steps=32 if what == "decode" else 3)
