@@ -79,6 +79,14 @@ class FFConfig:
     # Pallas attention call better than one wide gemm) — see the
     # measurement log in serve/gemm_fusion.py.
     gemm_fusion: bool = False
+    # compile the fused decode block with AUTO parameter layouts (XLA
+    # picks gemm-preferred weight layouts — engine.py
+    # make_decode_block_auto). Off by default: one controlled run
+    # measured -3.3% per decode step at 7B int8, but ordered A/B through
+    # this code path shows no repeatable end-to-end gain (PARITY.md
+    # round-4 record). Falls back to default layouts on any backend/API
+    # limitation.
+    decode_auto_layout: bool = False
     computation_mode: str = "training"
     seed: int = 0
     # numerics: params kept in param_dtype, compute in compute_dtype

@@ -127,10 +127,42 @@ class InferenceManager:
             # while_loop body cannot host-dump); same numerics, slower.
             return self._decode_block_debug(tok, pos, active, n_steps)
         if self._decode_block is None:
-            self._decode_block = make_decode_block(
-                self.model, self._compute_dtype,
-                self.model.config.decode_block_steps,
-                width=self.decode_width)
+            cfg = self.model.config
+            # AUTO layouts are a single-chip experiment: sharding-free
+            # avals would compile a single-device executable and
+            # de-shard a TP/PP model's params on relayout
+            if (cfg.decode_auto_layout and self.model._pp_plan is None
+                    and self.model.mesh.devices.size == 1):
+                try:
+                    from flexflow_tpu.serve.engine import \
+                        make_decode_block_auto
+
+                    blk = make_decode_block_auto(
+                        self.model, self._compute_dtype,
+                        cfg.decode_block_steps, width=self.decode_width)
+                    # AOT executables reject mismatched inputs instead of
+                    # retracing: validate with one all-inactive step (no
+                    # KV writes, outputs unread) BEFORE adopting the
+                    # path. A failure leaves params relayouted, which
+                    # jitted fallbacks handle by retracing.
+                    R = cfg.max_requests_per_batch
+                    z = jnp.zeros((R,), jnp.int32)
+                    _, st, _ = blk(self.model.params, self.model.op_state,
+                                   z, z, jnp.zeros((R,), bool),
+                                   jax.random.PRNGKey(0), jnp.int32(1))
+                    self.model.op_state = st
+                    self._decode_block = blk
+                except Exception as e:     # pragma: no cover - backend-dep
+                    import warnings
+
+                    warnings.warn(
+                        f"decode_auto_layout unavailable ({e}); using "
+                        "default layouts", stacklevel=2)
+            if self._decode_block is None:
+                self._decode_block = make_decode_block(
+                    self.model, self._compute_dtype,
+                    cfg.decode_block_steps,
+                    width=self.decode_width)
         n_steps = min(int(n_steps), self.model.config.decode_block_steps)
         self._rng, step_rng = jax.random.split(self._rng)
         toks, new_state, _last = self._decode_block(

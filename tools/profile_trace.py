@@ -87,13 +87,13 @@ def run_resnet(trace_dir):
         float(loss)
 
 
-def run_decode(trace_dir):
+def run_decode(trace_dir, fusion=True):
     import jax
 
     import bench
     from profile_decode import build
 
-    m, ifm = build(bench.LAYERS, bench)
+    m, ifm = build(bench.LAYERS, bench, fusion=fusion)
     R, P = bench.NUM_REQUESTS, bench.PROMPT_LEN
     tok = np.ones((R,), np.int32)
     pos = np.full((R,), P, np.int32)
@@ -105,6 +105,12 @@ def run_decode(trace_dir):
 
 if __name__ == "__main__":
     what = sys.argv[1] if len(sys.argv) > 1 else "resnet"
-    trace_dir = f"/tmp/fftrace_{what}_{int(time.time())}"
-    (run_decode if what == "decode" else run_resnet)(trace_dir)
-    aggregate(trace_dir, steps=32 if what == "decode" else 3)
+    modes = ("resnet", "decode", "decode-nofuse")
+    if what not in modes:
+        raise SystemExit(f"unknown mode {what!r}; pick one of {modes}")
+    trace_dir = f"/tmp/fftrace_{what.replace('-', '_')}_{int(time.time())}"
+    if what.startswith("decode"):
+        run_decode(trace_dir, fusion=(what != "decode-nofuse"))
+    else:
+        run_resnet(trace_dir)
+    aggregate(trace_dir, steps=32 if what.startswith("decode") else 3)
