@@ -119,6 +119,19 @@ def _declare(lib: ctypes.CDLL):
                       ("ffgb_embedding", [c.c_int, c.c_int, c.c_int,
                                           c.c_char_p]),
                       ("ffgb_reshape", [c.c_int, ip, c.c_int, c.c_char_p]),
+                      ("ffgb_layer_norm", [c.c_int, ip, c.c_int, c.c_int,
+                                           c.c_double, c.c_char_p]),
+                      ("ffgb_batch_norm", [c.c_int, c.c_char_p]),
+                      ("ffgb_rms_norm", [c.c_int, c.c_double, c.c_int,
+                                         c.c_char_p]),
+                      ("ffgb_multihead_attention",
+                       [c.c_int] * 5 + [c.c_double, c.c_char_p]),
+                      ("ffgb_scalar", [c.c_int, c.c_char_p, c.c_double,
+                                       c.c_int, c.c_char_p]),
+                      ("ffgb_transpose", [c.c_int, ip, c.c_int, c.c_char_p]),
+                      ("ffgb_mean", [c.c_int, ip, c.c_int, c.c_int,
+                                     c.c_char_p]),
+                      ("ffgb_cast", [c.c_int, c.c_char_p, c.c_char_p]),
                       ("ffgb_output", [ip, c.c_int]),
                       ("ffgb_save", [c.c_char_p]),
                       ("ffgb_serialize", [c.c_char_p, c.c_int])):

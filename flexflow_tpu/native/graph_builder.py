@@ -101,6 +101,53 @@ class NativeGraphBuilder:
         return self._chk(self._lib.ffgb_reshape(self._h, in_id, arr,
                                                 len(shape), self._nm(name)))
 
+    def layer_norm(self, in_id: int, normalized_shape: Sequence[int],
+                   affine: bool = True, eps: float = 1e-5,
+                   name: Optional[str] = None) -> int:
+        arr = (ctypes.c_int * len(normalized_shape))(*normalized_shape)
+        return self._chk(self._lib.ffgb_layer_norm(
+            self._h, in_id, arr, len(normalized_shape), int(affine),
+            float(eps), self._nm(name)))
+
+    def batch_norm(self, in_id: int, name: Optional[str] = None) -> int:
+        return self._chk(self._lib.ffgb_batch_norm(self._h, in_id,
+                                                   self._nm(name)))
+
+    def rms_norm(self, in_id: int, eps: float = 1e-6, dim: int = 0,
+                 name: Optional[str] = None) -> int:
+        return self._chk(self._lib.ffgb_rms_norm(
+            self._h, in_id, float(eps), dim, self._nm(name)))
+
+    def multihead_attention(self, q: int, k: int, v: int, embed_dim: int,
+                            num_heads: int, dropout: float = 0.0,
+                            name: Optional[str] = None) -> int:
+        return self._chk(self._lib.ffgb_multihead_attention(
+            self._h, q, k, v, embed_dim, num_heads, float(dropout),
+            self._nm(name)))
+
+    def scalar(self, in_id: int, op: str, scalar: float,
+               reverse: bool = False, name: Optional[str] = None) -> int:
+        return self._chk(self._lib.ffgb_scalar(
+            self._h, in_id, op.encode(), float(scalar), int(reverse),
+            self._nm(name)))
+
+    def transpose(self, in_id: int, perm: Sequence[int],
+                  name: Optional[str] = None) -> int:
+        arr = (ctypes.c_int * len(perm))(*perm)
+        return self._chk(self._lib.ffgb_transpose(
+            self._h, in_id, arr, len(perm), self._nm(name)))
+
+    def mean(self, in_id: int, dims: Sequence[int], keepdims: bool = False,
+             name: Optional[str] = None) -> int:
+        arr = (ctypes.c_int * len(dims))(*dims)
+        return self._chk(self._lib.ffgb_mean(
+            self._h, in_id, arr, len(dims), int(keepdims), self._nm(name)))
+
+    def cast(self, in_id: int, dtype: str,
+             name: Optional[str] = None) -> int:
+        return self._chk(self._lib.ffgb_cast(self._h, in_id, dtype.encode(),
+                                             self._nm(name)))
+
     def output(self, ids: Sequence[int]):
         arr = (ctypes.c_int * len(ids))(*ids)
         if self._lib.ffgb_output(self._h, arr, len(ids)) != 0:

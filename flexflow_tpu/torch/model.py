@@ -936,6 +936,11 @@ def ir_to_ff(ir: List[IRNode], ffmodel, input_tensors: Sequence,
             out = ffmodel.layer_norm(ins[0], axes,
                                      elementwise_affine=a["affine"],
                                      eps=a["eps"], name=n.name)
+        elif n.op == "rms_norm":
+            # emitted by the C graph-builder ABI (ffgb_rms_norm); the fx
+            # tracer has no torch.nn.RMSNorm source yet
+            out = ffmodel.rms_norm(ins[0], eps=a.get("eps", 1e-6),
+                                   dim=a.get("dim"), name=n.name)
         elif n.op == "dropout":
             out = ffmodel.dropout(ins[0], a["rate"], name=n.name)
         elif n.op == "softmax":

@@ -143,6 +143,26 @@ int ffgb_embedding(void *handle, int in, int num_entries, int out_dim,
                    const char *name);
 int ffgb_reshape(void *handle, int in, const int *shape, int ndims,
                  const char *name);
+/* Normalize over the LAST ndims dims (sizes in normalized_shape). */
+int ffgb_layer_norm(void *handle, int in, const int *normalized_shape,
+                    int ndims, int affine, double eps, const char *name);
+int ffgb_batch_norm(void *handle, int in, const char *name);
+/* dim <= 0 -> default (input's last-dim size). */
+int ffgb_rms_norm(void *handle, int in, double eps, int dim,
+                  const char *name);
+/* Training MHA; pass the same id for q/k/v for self-attention. */
+int ffgb_multihead_attention(void *handle, int q, int k, int v,
+                             int embed_dim, int num_heads, double dropout,
+                             const char *name);
+/* op: add subtract multiply divide; reverse != 0 -> (scalar OP x). */
+int ffgb_scalar(void *handle, int in, const char *op, double scalar,
+                int reverse, const char *name);
+int ffgb_transpose(void *handle, int in, const int *perm, int ndims,
+                   const char *name);
+int ffgb_mean(void *handle, int in, const int *dims, int ndims,
+              int keepdims, const char *name);
+/* dtype name per flexflow_tpu.ffconst.DataType values, e.g. "float32". */
+int ffgb_cast(void *handle, int in, const char *dtype, const char *name);
 int ffgb_output(void *handle, const int *ids, int n);
 int ffgb_save(void *handle, const char *path);
 int ffgb_serialize(void *handle, char *out, int cap);

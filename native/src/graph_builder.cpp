@@ -10,6 +10,7 @@
 // the runtime (flexflow_tpu.torch.model.file_to_ff -> FFModel.compile).
 
 #include <cstdint>
+#include <limits>
 #include <cstdio>
 #include <cstring>
 #include <map>
@@ -72,6 +73,20 @@ std::string json_str(const std::string &s) {
 
 GraphBuilder *GB(void *h) { return static_cast<GraphBuilder *>(h); }
 
+bool one_of(const char *s, const char *const *ok, size_t n) {
+  for (size_t i = 0; i < n; i++)
+    if (std::string(ok[i]) == s) return true;
+  return false;
+}
+
+/* attr stream with full double round-trip precision (the default 6
+ * significant digits silently truncates host-specified constants) */
+std::ostringstream attr_stream() {
+  std::ostringstream a;
+  a.precision(std::numeric_limits<double>::max_digits10);
+  return a;
+}
+
 bool valid(GraphBuilder *g, int id) {
   return id >= 0 && id < static_cast<int>(g->nodes.size());
 }
@@ -89,7 +104,7 @@ int ffgb_input(void *h, int index, const char *name) {
   GraphBuilder *g = GB(h);
   if (index < 0) return -1;   // python negative indexing would silently
                               // bind the LAST runtime tensor
-  std::ostringstream a;
+  std::ostringstream a = attr_stream();
   a << "\"index\": " << index;
   return g->add("input", g->fresh(name, "input"), {}, a.str());
 }
@@ -98,7 +113,7 @@ int ffgb_dense(void *h, int in, int out_dim, int use_bias,
                const char *name) {
   GraphBuilder *g = GB(h);
   if (!valid(g, in)) return -1;
-  std::ostringstream a;
+  std::ostringstream a = attr_stream();
   a << "\"out_dim\": " << out_dim
     << ", \"use_bias\": " << (use_bias ? "true" : "false");
   return g->add("linear", g->fresh(name, "linear"), {g->name_of(in)},
@@ -110,7 +125,7 @@ int ffgb_conv2d(void *h, int in, int out_channels, int kh, int kw, int sh,
                 const char *name) {
   GraphBuilder *g = GB(h);
   if (!valid(g, in)) return -1;
-  std::ostringstream a;
+  std::ostringstream a = attr_stream();
   a << "\"out_channels\": " << out_channels << ", \"kernel\": [" << kh
     << ", " << kw << "], \"stride\": [" << sh << ", " << sw
     << "], \"padding\": [" << ph << ", " << pw << "], \"groups\": " << groups
@@ -124,7 +139,7 @@ int ffgb_pool2d(void *h, int in, int kh, int kw, int sh, int sw, int ph,
                 int pw, int is_max, const char *name) {
   GraphBuilder *g = GB(h);
   if (!valid(g, in)) return -1;
-  std::ostringstream a;
+  std::ostringstream a = attr_stream();
   a << "\"kernel\": [" << kh << ", " << kw << "], \"stride\": [" << sh
     << ", " << sw << "], \"padding\": [" << ph << ", " << pw
     << "], \"pool\": " << (is_max ? "\"max\"" : "\"avg\"");
@@ -138,9 +153,7 @@ int ffgb_unary(void *h, int in, const char *op, const char *name) {
   if (!valid(g, in)) return -1;
   static const char *ok[] = {"relu", "sigmoid", "tanh",  "gelu",
                              "elu",  "identity", "flat", "rsqrt"};
-  bool found = false;
-  for (const char *o : ok) found = found || (std::string(o) == op);
-  if (!found) return -1;
+  if (!one_of(op, ok, sizeof(ok) / sizeof(*ok))) return -1;
   return g->add(op, g->fresh(name, op), {g->name_of(in)}, "");
 }
 
@@ -151,9 +164,7 @@ int ffgb_binary(void *h, int a_id, int b_id, const char *op,
   if (!valid(g, a_id) || !valid(g, b_id)) return -1;
   static const char *ok[] = {"add", "subtract", "multiply", "divide",
                              "max", "min",      "batch_matmul"};
-  bool found = false;
-  for (const char *o : ok) found = found || (std::string(o) == op);
-  if (!found) return -1;
+  if (!one_of(op, ok, sizeof(ok) / sizeof(*ok))) return -1;
   return g->add(op, g->fresh(name, op),
                 {g->name_of(a_id), g->name_of(b_id)}, "");
 }
@@ -165,7 +176,7 @@ int ffgb_concat(void *h, const int *ins, int n, int axis, const char *name) {
     if (!valid(g, ins[i])) return -1;
     names.push_back(g->name_of(ins[i]));
   }
-  std::ostringstream a;
+  std::ostringstream a = attr_stream();
   a << "\"axis\": " << axis;
   return g->add("concat", g->fresh(name, "concat"), std::move(names),
                 a.str());
@@ -174,7 +185,7 @@ int ffgb_concat(void *h, const int *ins, int n, int axis, const char *name) {
 int ffgb_softmax(void *h, int in, int axis, const char *name) {
   GraphBuilder *g = GB(h);
   if (!valid(g, in)) return -1;
-  std::ostringstream a;
+  std::ostringstream a = attr_stream();
   a << "\"axis\": " << axis;
   return g->add("softmax", g->fresh(name, "softmax"), {g->name_of(in)},
                 a.str());
@@ -183,7 +194,7 @@ int ffgb_softmax(void *h, int in, int axis, const char *name) {
 int ffgb_dropout(void *h, int in, double rate, const char *name) {
   GraphBuilder *g = GB(h);
   if (!valid(g, in)) return -1;
-  std::ostringstream a;
+  std::ostringstream a = attr_stream();
   a << "\"rate\": " << rate;
   return g->add("dropout", g->fresh(name, "dropout"), {g->name_of(in)},
                 a.str());
@@ -193,7 +204,7 @@ int ffgb_embedding(void *h, int in, int num_entries, int out_dim,
                    const char *name) {
   GraphBuilder *g = GB(h);
   if (!valid(g, in)) return -1;
-  std::ostringstream a;
+  std::ostringstream a = attr_stream();
   a << "\"num_entries\": " << num_entries << ", \"out_dim\": " << out_dim;
   return g->add("embedding", g->fresh(name, "embedding"), {g->name_of(in)},
                 a.str());
@@ -203,12 +214,117 @@ int ffgb_reshape(void *h, int in, const int *shape, int ndims,
                  const char *name) {
   GraphBuilder *g = GB(h);
   if (!valid(g, in)) return -1;
-  std::ostringstream a;
+  std::ostringstream a = attr_stream();
   a << "\"shape\": [";
   for (int i = 0; i < ndims; i++) a << (i ? ", " : "") << shape[i];
   a << "]";
   return g->add("reshape", g->fresh(name, "reshape"), {g->name_of(in)},
                 a.str());
+}
+
+/* Normalize over the last ``ndims`` dims (sizes in normalized_shape;
+ * the loader derives the axes from the count). */
+int ffgb_layer_norm(void *h, int in, const int *normalized_shape, int ndims,
+                    int affine, double eps, const char *name) {
+  GraphBuilder *g = GB(h);
+  if (!valid(g, in) || ndims <= 0) return -1;
+  std::ostringstream a = attr_stream();
+  a << "\"normalized_shape\": [";
+  for (int i = 0; i < ndims; i++) a << (i ? ", " : "") << normalized_shape[i];
+  a << "], \"affine\": " << (affine ? "true" : "false")
+    << ", \"eps\": " << eps;
+  return g->add("layer_norm", g->fresh(name, "layer_norm"), {g->name_of(in)},
+                a.str());
+}
+
+int ffgb_batch_norm(void *h, int in, const char *name) {
+  GraphBuilder *g = GB(h);
+  if (!valid(g, in)) return -1;
+  return g->add("batch_norm", g->fresh(name, "batch_norm"),
+                {g->name_of(in)}, "");
+}
+
+/* dim <= 0 -> default (the input's last-dim size). */
+int ffgb_rms_norm(void *h, int in, double eps, int dim, const char *name) {
+  GraphBuilder *g = GB(h);
+  if (!valid(g, in)) return -1;
+  std::ostringstream a = attr_stream();
+  a << "\"eps\": " << eps;
+  if (dim > 0) a << ", \"dim\": " << dim;
+  return g->add("rms_norm", g->fresh(name, "rms_norm"), {g->name_of(in)},
+                a.str());
+}
+
+/* Training-style MHA (reference FFModel::multihead_attention); q/k/v are
+ * node ids (pass the same id three times for self-attention). */
+int ffgb_multihead_attention(void *h, int q, int k, int v, int embed_dim,
+                             int num_heads, double dropout,
+                             const char *name) {
+  GraphBuilder *g = GB(h);
+  if (!valid(g, q) || !valid(g, k) || !valid(g, v)) return -1;
+  if (embed_dim <= 0 || num_heads <= 0 || embed_dim % num_heads) return -1;
+  std::ostringstream a = attr_stream();
+  a << "\"embed_dim\": " << embed_dim << ", \"num_heads\": " << num_heads
+    << ", \"dropout\": " << dropout;
+  return g->add("multihead_attention", g->fresh(name, "multihead_attention"),
+                {g->name_of(q), g->name_of(k), g->name_of(v)}, a.str());
+}
+
+/* op in: add subtract multiply divide; reverse != 0 -> (scalar OP x). */
+int ffgb_scalar(void *h, int in, const char *op, double scalar, int reverse,
+                const char *name) {
+  GraphBuilder *g = GB(h);
+  if (!valid(g, in)) return -1;
+  static const char *ok[] = {"add", "subtract", "multiply", "divide"};
+  if (!one_of(op, ok, sizeof(ok) / sizeof(*ok))) return -1;
+  std::string full = std::string("scalar_") + op;
+  std::ostringstream a = attr_stream();
+  a << "\"scalar\": " << scalar
+    << ", \"reverse\": " << (reverse ? "true" : "false");
+  return g->add(full, g->fresh(name, full.c_str()), {g->name_of(in)},
+                a.str());
+}
+
+/* Permutation of ALL input dims (ndims entries). */
+int ffgb_transpose(void *h, int in, const int *perm, int ndims,
+                   const char *name) {
+  GraphBuilder *g = GB(h);
+  if (!valid(g, in) || ndims <= 0) return -1;
+  std::vector<bool> seen(ndims, false);
+  for (int i = 0; i < ndims; i++) {
+    if (perm[i] < 0 || perm[i] >= ndims || seen[perm[i]]) return -1;
+    seen[perm[i]] = true;
+  }
+  std::ostringstream a = attr_stream();
+  a << "\"perm\": [";
+  for (int i = 0; i < ndims; i++) a << (i ? ", " : "") << perm[i];
+  a << "]";
+  return g->add("permute", g->fresh(name, "permute"), {g->name_of(in)},
+                a.str());
+}
+
+int ffgb_mean(void *h, int in, const int *dims, int ndims, int keepdims,
+              const char *name) {
+  GraphBuilder *g = GB(h);
+  if (!valid(g, in) || ndims <= 0) return -1;
+  std::ostringstream a = attr_stream();
+  a << "\"dims\": [";
+  for (int i = 0; i < ndims; i++) a << (i ? ", " : "") << dims[i];
+  a << "], \"keepdims\": " << (keepdims ? "true" : "false");
+  return g->add("mean", g->fresh(name, "mean"), {g->name_of(in)}, a.str());
+}
+
+/* dtype name as in flexflow_tpu.ffconst.DataType values:
+ * bool int32 int64 float16 bfloat16 float32 float64 int8. */
+int ffgb_cast(void *h, int in, const char *dtype, const char *name) {
+  GraphBuilder *g = GB(h);
+  if (!valid(g, in)) return -1;
+  static const char *ok[] = {"bool",    "int32",   "int64",   "float16",
+                             "bfloat16", "float32", "float64", "int8"};
+  if (!one_of(dtype, ok, sizeof(ok) / sizeof(*ok))) return -1;
+  std::ostringstream a = attr_stream();
+  a << "\"dtype\": " << json_str(dtype);
+  return g->add("cast", g->fresh(name, "cast"), {g->name_of(in)}, a.str());
 }
 
 /* Mark the graph outputs. Call once, last. Returns 0 on success. */
