@@ -1,0 +1,28 @@
+"""Shared scaffolding for the C graph-builder examples: compile the C
+host against the native library and run it to emit the frontend IR."""
+
+import os
+import subprocess
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.abspath(os.path.join(_HERE, *[os.pardir] * 2))
+
+
+def compile_and_emit(c_basename: str, tmpdir: str) -> str:
+    """Build examples/c/<c_basename> and run it; returns the IR path."""
+    from flexflow_tpu.native import load_native
+
+    if load_native() is None:
+        raise SystemExit("native toolchain unavailable")
+    exe = os.path.join(tmpdir, os.path.splitext(c_basename)[0])
+    ir = os.path.join(tmpdir, "model.ir")
+    lib_dir = os.path.join(_ROOT, "native", "build")
+    subprocess.run([os.environ.get("CC", "cc"),
+                    os.path.join(_HERE, c_basename),
+                    "-L" + lib_dir, "-lflexflow_tpu_native", "-o", exe],
+                   check=True)
+    env = dict(os.environ)
+    env["LD_LIBRARY_PATH"] = os.pathsep.join(
+        p for p in (lib_dir, env.get("LD_LIBRARY_PATH")) if p)
+    subprocess.run([exe, ir], check=True, env=env)
+    return ir

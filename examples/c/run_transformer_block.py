@@ -1,6 +1,7 @@
-"""Driver for the C-built MNIST MLP: compile the C host, run it to emit
-the IR, load with file_to_ff, train (reference examples/cpp flow where a
-native main owns model construction)."""
+"""Driver for the C-built transformer encoder block: compile the C host,
+run it to emit the IR, load with file_to_ff, train on a synthetic
+token-classification task (reference examples/cpp flow where a native
+main owns model construction)."""
 
 import os as _os
 import sys as _sys
@@ -13,7 +14,6 @@ _sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
 import numpy as np
 
 import flexflow_tpu as ff
-from flexflow_tpu.keras.datasets import mnist
 from flexflow_tpu.torch.model import file_to_ff
 
 from _build import compile_and_emit
@@ -22,19 +22,21 @@ from _build import compile_and_emit
 def top_level_task():
     config = ff.FFConfig.from_args()
     with _tf.TemporaryDirectory() as td:
-        ir = compile_and_emit("mnist_mlp.c", td)
+        ir = compile_and_emit("transformer_block.c", td)
         model = ff.FFModel(config)
-        t = model.create_tensor([config.batch_size, 784],
-                                ff.DataType.DT_FLOAT)
+        t = model.create_tensor([config.batch_size, 16],
+                                ff.DataType.DT_INT32)
         file_to_ff(ir, model, [t])
     model.compile(
         optimizer=ff.SGDOptimizer(model, lr=config.learning_rate),
         loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
         metrics=[ff.MetricsType.METRICS_ACCURACY])
-    (x_train, y_train), _ = mnist.load_data()
-    x_train = x_train.reshape(-1, 784).astype(np.float32) / 255.0
-    y_train = y_train.reshape(-1, 1).astype(np.int32)
-    model.fit(x_train, y_train, epochs=config.epochs)
+    # synthetic task: class = leading token bucket (learnable by the
+    # embedding + attention stack in a few epochs)
+    rng = np.random.RandomState(0)
+    xs = rng.randint(0, 512, size=(512, 16)).astype(np.int32)
+    ys = (xs[:, 0] % 8).reshape(-1, 1).astype(np.int32)
+    model.fit(xs, ys, epochs=config.epochs)
 
 
 if __name__ == "__main__":

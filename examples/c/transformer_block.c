@@ -1,0 +1,46 @@
+/* Transformer encoder block built through the native C graph-builder ABI
+ * (round-4 surface: attention, norms, scalar/mean ops from C — the
+ * model-builder breadth of the reference C API, src/c/flexflow_c.cc).
+ *
+ *   cc transformer_block.c -L../../native/build -lflexflow_tpu_native \
+ *      -o transformer_block
+ *   ./transformer_block model.ir
+ */
+#include <stdio.h>
+
+#include "../../native/include/flexflow_tpu_c.h"
+
+int main(int argc, char **argv) {
+  const char *out_path = argc > 1 ? argv[1] : "transformer_block.ir";
+  void *g = ffgb_create();
+  int toks = ffgb_input(g, 0, "tokens");
+  int h = ffgb_embedding(g, toks, 512, 64, "embed");
+
+  /* self-attention + residual layer norm */
+  int norm_shape[1] = {64};
+  int attn = ffgb_multihead_attention(g, h, h, h, 64, 4, 0.0, "attn");
+  h = ffgb_layer_norm(g, ffgb_binary(g, h, attn, "add", NULL), norm_shape,
+                      1 /* ndims */, 1 /* affine */, 1e-5, "ln1");
+
+  /* MLP + residual rms norm */
+  int up = ffgb_unary(g, ffgb_dense(g, h, 256, 1, "up"), "gelu", NULL);
+  int down = ffgb_dense(g, up, 64, 1, "down");
+  h = ffgb_rms_norm(g, ffgb_binary(g, h, down, "add", NULL), 1e-6, 0, "rn");
+
+  /* mean-pool the sequence, classify */
+  int pool_dims[1] = {1};
+  int pooled = ffgb_mean(g, h, pool_dims, 1, 0, "pool");
+  int probs = ffgb_softmax(g, ffgb_dense(g, pooled, 8, 1, "head"), -1, NULL);
+
+  int outs[1];
+  outs[0] = probs;
+  if (probs < 0 || ffgb_output(g, outs, 1) != 0 ||
+      ffgb_save(g, out_path) != 0) {
+    fprintf(stderr, "failed to build/serialize graph\n");
+    ffgb_destroy(g);
+    return 1;
+  }
+  printf("wrote %s\n", out_path);
+  ffgb_destroy(g);
+  return 0;
+}
