@@ -1,0 +1,47 @@
+"""Export an fx-traced RegNet to the serialized frontend IR and reload
+it (reference examples/python/pytorch/export_regnet_fx.py: torch_to_file
+-> a .ff file another process trains from; classy_vision isn't in this
+image, so the RegNet body comes from regnet.py's modules)."""
+
+import os as _os
+import sys as _sys
+import tempfile as _tf
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+
+import numpy as np
+import flexflow_tpu as ff
+from flexflow_tpu.torch.model import PyTorchModel, file_to_ff
+
+from regnet import RegNetTiny
+
+
+def top_level_task():
+    config = ff.FFConfig.from_args()
+    model = RegNetTiny()
+    with _tf.TemporaryDirectory() as td:
+        path = _os.path.join(td, "regnet.ff")
+        PyTorchModel(model, batch_size=config.batch_size
+                     ).torch_to_file(path)
+        print(f"exported {path} "
+              f"({sum(1 for _ in open(path))} IR nodes)")
+
+        ffmodel = ff.FFModel(config)
+        t = ffmodel.create_tensor([config.batch_size, 3, 32, 32],
+                                  ff.DataType.DT_FLOAT)
+        outs = file_to_ff(path, ffmodel, [t])
+    ffmodel.softmax(outs[0])
+    ffmodel.compile(
+        optimizer=ff.SGDOptimizer(ffmodel, lr=config.learning_rate),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY])
+    rng = np.random.RandomState(0)
+    xs = rng.randn(256, 3, 32, 32).astype(np.float32)
+    ys = rng.randint(0, 10, size=(256, 1)).astype(np.int32)
+    ffmodel.fit(xs, ys, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -122,29 +122,47 @@ class PyTorchModel:
             if n.op == "call_module"
             and isinstance(mods.get(n.target), nn.MultiheadAttention)}
         placeholders = 0
+        # fx edge names vs IR layer names: call_module nodes are named
+        # from their TARGET (so weight copy matches named_modules), but
+        # consumers reference fx's sanitized node.name — for digit-named
+        # Sequential children ("0" -> fx "_0") the two diverge. Map every
+        # fx name to the IR name it became and rewrite inputs through it.
+        # Target-derived names can also COLLIDE with earlier edge names
+        # (a submodule attribute named like a forward arg): uniquify and
+        # record the rename so copy_weights still finds the layer.
+        alias: Dict[str, str] = {}
+        used: set = set()
+        self._module_renames: Dict[str, str] = {}
         for node in self.traced.graph.nodes:
-            ins = [a.name for a in node.args
-                   if isinstance(a, torch.fx.Node)]
             if node.op == "placeholder":
-                ir.append(IRNode("input", node.name, [],
-                                 {"index": placeholders}))
+                made = IRNode("input", node.name, [],
+                              {"index": placeholders})
                 placeholders += 1
             elif node.op == "get_attr":
                 raise NotImplementedError(
                     f"get_attr node {node.target!r} not supported")
             elif node.op == "call_module":
-                ir.append(self._module_ir(node, mods[node.target]))
+                made = self._module_ir(node, mods[node.target])
             elif node.op == "call_function":
-                ir.append(self._function_ir(node))
+                made = self._function_ir(node)
             elif node.op == "call_method":
-                ir.append(self._method_ir(node))
+                made = self._method_ir(node)
             elif node.op == "output":
                 outs = node.args[0]
                 outs = outs if isinstance(outs, (tuple, list)) else [outs]
-                ir.append(IRNode("output", node.name,
-                                 [o.name for o in outs], {}))
+                made = IRNode("output", node.name,
+                              [o.name for o in outs], {})
             else:
                 raise NotImplementedError(f"fx op {node.op}")
+            made.inputs = [alias.get(i, i) for i in made.inputs]
+            base = made.name
+            while made.name in used:
+                made.name += "_"
+            if made.name != base and node.op == "call_module":
+                self._module_renames[base] = made.name
+            used.add(made.name)
+            alias[node.name] = made.name
+            ir.append(made)
         self._ir = ir
         return ir
 
@@ -356,8 +374,10 @@ class PyTorchModel:
             # like encoder.embed_tokens have no layer of their own);
             # copying walks the IR's recorded sources instead
             return self._copy_weights_hf(ffmodel)
+        self.to_ir()                  # populates _module_renames
         for tname, mod in self.module.named_modules():
             name = tname.replace(".", "_")
+            name = getattr(self, "_module_renames", {}).get(name, name)
             if isinstance(mod, nn.Linear):
                 ffmodel.set_parameter_by_key(
                     (name, "kernel"),

@@ -380,3 +380,42 @@ def test_mt5_ir_roundtrip(tiny_mt5, tmp_path):
            fm.create_tensor([B, S_dec], ff.DataType.DT_INT32)]
     outs = file_to_ff(str(p), fm, ins)
     assert outs[0].dims == (B, S_dec, 250)
+
+
+def test_sequential_integer_child_names():
+    """nn.Sequential children are named '0','1',... — fx sanitizes edge
+    references to '_0' while layer names come from the target; the IR
+    alias map must reconcile them (reference export_regnet_fx wraps
+    models in nn.Sequential)."""
+    import torch.nn as nn
+
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    m = ff.FFModel(ff.FFConfig(batch_size=4))
+    t = m.create_tensor([4, 8], ff.DataType.DT_FLOAT)
+    outs = PyTorchModel(model, batch_size=4).torch_to_ff(m, [t])
+    assert outs[0].dims == (4, 4)
+    m.softmax(outs[0])
+    m.compile(optimizer=ff.SGDOptimizer(m, lr=0.1),
+              loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY)
+    xs = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    ys = np.array([[0], [1], [2], [3]], np.int32)
+    assert np.isfinite(m.train_one_batch([xs], ys))
+
+
+def test_module_name_collides_with_forward_arg():
+    """A submodule attribute named like a forward arg ('self.x' + arg
+    'x') must not miswire the residual: the IR uniquifies the layer name
+    and weight copy follows the rename. Verified against torch."""
+    import torch
+
+    class M(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.x = nn.Linear(4, 4)
+
+        def forward(self, x):
+            return self.x(x) + x
+
+    torch.manual_seed(0)
+    xs = np.random.RandomState(1).randn(4, 4).astype(np.float32)
+    _align(M(), xs, 4)
