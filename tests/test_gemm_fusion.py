@@ -15,17 +15,19 @@ from flexflow_tpu.serve.request_manager import RequestManager
 PROMPT = [5, 9, 23, 7]
 
 
-def _build_llama(quant=None, fusion=True, gqa=True, mode=None):
+def _build_llama(quant=None, fusion=True, gqa=True, mode=None,
+                 kv_heads=None, seed=3):
     cfg = ff.FFConfig(max_requests_per_batch=2, max_sequence_length=64,
                       max_tokens_per_batch=16, kv_cache_dtype="float32",
                       quantization_type=quant, enable_fusion=fusion,
-                      gemm_fusion=fusion, seed=3)
+                      gemm_fusion=fusion, seed=seed)
     m = ff.FFModel(cfg)
     create_llama_model(
         m,
         LLAMAConfig(vocab_size=128, hidden_size=128, intermediate_size=96,
                     num_hidden_layers=2, num_attention_heads=4,
-                    num_key_value_heads=2 if gqa else 4,
+                    num_key_value_heads=(kv_heads if kv_heads is not None
+                                         else 2 if gqa else 4),
                     max_position_embeddings=64),
         mode or InferenceMode.INC_DECODING_MODE)
     m.compile(comp_mode=CompMode.COMP_MODE_INFERENCE)
@@ -265,3 +267,14 @@ def test_fused_param_set_rejects_wrong_shape():
     with pytest.raises(AssertionError):
         m.set_parameter_by_key(("layers.0.self_attn", "wq"),
                                np.zeros(128, np.float32))
+
+
+def test_mqa_fusion_matches_unfused():
+    """Multi-query attention (KH=1, StarCoder-style) has maximally
+    asymmetric qkv widths (H*D vs D vs D) — the fused slice offsets must
+    still land exactly."""
+    base = _gen(_build_llama(fusion=False, kv_heads=1, seed=9))
+    m = _build_llama(fusion=True, kv_heads=1, seed=9)
+    assert _gen(m) == base
+    lp = m.params["layers.0.self_attn"]
+    assert "wqkv" in lp and lp["wqkv"].shape == (128, 128 + 2 * 32)
