@@ -10,7 +10,7 @@ import os as _os
 import sys as _sys
 
 _sys.path.insert(0, _os.path.abspath(_os.path.join(
-    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+    _os.path.dirname(__file__), *[_os.pardir] * 2)))
 
 import argparse
 import time

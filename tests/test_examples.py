@@ -20,9 +20,12 @@ EXAMPLES = sorted(
     os.path.relpath(p, _ROOT)
     for p in glob.glob(os.path.join(_ROOT, "examples", "python", "*", "*.py"))
     + glob.glob(os.path.join(_ROOT, "examples", "c", "*.py"))
+    + glob.glob(os.path.join(_ROOT, "inference", "python", "*.py"))
 )
 
-# every script accepts FFConfig.from_args flags (unknown flags ignored)
+# examples/ scripts accept FFConfig.from_args flags (unknown flags
+# ignored); inference/ entry points use STRICT argparse and therefore
+# need an explicit _SMALL_BATCH entry with their own flags
 _ARGS = ["-e", "1", "-b", "32"]
 # scripts whose own data sizes need a smaller batch to keep CI fast
 _SMALL_BATCH = {
@@ -44,6 +47,9 @@ _SMALL_BATCH = {
     "examples/python/keras/func_cifar10_cnn_net2net.py": ["-e", "1", "-b", "16"],
     "examples/python/keras/func_cifar10_cnn_concat_model.py": ["-e", "1", "-b", "16"],
     "examples/python/keras/func_cifar10_cnn_concat_seq_model.py": ["-e", "1", "-b", "16"],
+    # serving entry points take their own argparse flags
+    "inference/python/incr_decoding.py": ["--max-new-tokens", "4"],
+    "inference/python/spec_infer.py": ["--max-new-tokens", "4"],
 }
 
 
