@@ -561,7 +561,20 @@ def concatenate(inputs, axis: int = 1, **kwargs):
     return Concatenate(axis=axis, **kwargs)(inputs)
 
 
-# tensor arithmetic sugar (`x + y` in the reference rsqrt example)
-KerasTensor.__add__ = lambda self, other: add([self, other])
-KerasTensor.__sub__ = lambda self, other: subtract([self, other])
-KerasTensor.__mul__ = lambda self, other: multiply([self, other])
+# tensor arithmetic sugar (`x + y` in the reference rsqrt example).
+# Only tensor-tensor pairs are supported; a non-tensor operand returns
+# NotImplemented so Python raises a clear TypeError instead of crashing
+# deep inside layer building (and reflected ops mirror the same rule).
+def _binary_sugar(layer_fn):
+    def op(self, other):
+        if not isinstance(other, KerasTensor):
+            return NotImplemented
+        return layer_fn([self, other])
+    return op
+
+
+KerasTensor.__add__ = _binary_sugar(add)
+KerasTensor.__radd__ = _binary_sugar(lambda ins: add(ins[::-1]))
+KerasTensor.__sub__ = _binary_sugar(subtract)
+KerasTensor.__mul__ = _binary_sugar(multiply)
+KerasTensor.__rmul__ = _binary_sugar(lambda ins: multiply(ins[::-1]))

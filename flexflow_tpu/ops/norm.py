@@ -247,12 +247,22 @@ class BatchNorm(OpImpl):
             # B*H*W-sized channels loses the mean outright. One-pass form
             # (E[x^2] - mean^2): both reductions fuse into the producing
             # conv's epilogue instead of forcing a second activation read
-            # the two-pass jnp.var form needs.
+            # the two-pass jnp.var form needs. The raw one-pass form
+            # cancels catastrophically when |mean| >> std, so statistics
+            # are computed about the RUNNING mean c (one pass still:
+            # E[(x-c)^2] - (mean-c)^2) — the cancellation then scales
+            # with the batch-to-running drift, which shrinks as training
+            # stabilizes, exactly when tight precision starts mattering.
             xf = x.astype(jnp.float32)
-            mean = jnp.mean(xf, axis=reduce_axes)
+            c = (state["running_mean"].reshape(bshape)
+                 if state is not None else jnp.float32(0.0))
+            xs = xf - c
+            dmean = jnp.mean(xs, axis=reduce_axes)
+            mean = dmean + (state["running_mean"] if state is not None
+                            else 0.0)
             var = jnp.maximum(
-                jnp.mean(jnp.square(xf), axis=reduce_axes) - jnp.square(mean),
-                0.0)
+                jnp.mean(jnp.square(xs), axis=reduce_axes)
+                - jnp.square(dmean), 0.0)
             if state is not None:
                 ctx.state_out[ctx.layer_name] = {
                     "running_mean": (1 - momentum) * state["running_mean"]

@@ -231,6 +231,10 @@ class LLM:
             requests_or_prompts and
             isinstance(requests_or_prompts[0], int))
         prompts = [requests_or_prompts] if single else list(requests_or_prompts)
+        if not prompts:
+            # an empty submission would otherwise enqueue a waiter no
+            # generation round ever releases (server mode blocks forever)
+            return []
         if self._server is not None:
             # server mode: enqueue into the background loop's continuous
             # batch and block until THIS submission's requests finish;

@@ -130,26 +130,34 @@ def _fuse_attention_qkv(model) -> int:
     return n
 
 
-def _sole_consumer(model, tensor) -> Optional[object]:
+def _graph_maps(model):
+    """One O(L) pass: tensor_id -> producing layer, tensor_id -> list of
+    consuming layers (per occurrence)."""
+    prod = {}
+    cons: dict = {}
+    for ly in model.layers:
+        for t in ly.outputs:
+            prod[t.tensor_id] = ly
+        for t in ly.inputs:
+            cons.setdefault(t.tensor_id, []).append(ly)
+    return prod, cons
+
+
+def _sole_consumer(model, cons, tensor) -> Optional[object]:
     """The single layer consuming ``tensor``, or None if 0 / >1 / it is
     the graph's final or logits tensor."""
     if tensor in (model._final_tensor, model._logits_tensor):
         return None
-    hits = [ly for ly in model.layers
-            if any(t.tensor_id == tensor.tensor_id for t in ly.inputs)]
-    if len(hits) == 1 and hits[0].inputs.count(tensor) == 1:
+    hits = cons.get(tensor.tensor_id, [])
+    if len(hits) == 1:
         return hits[0]
     return None
 
 
-def _fusable_gate_up(model, ssm):
+def _fusable_gate_up(model, ssm, prod, cons):
     """(gate_layer, up_layer) for a fusable SwiGLU pair, else None."""
     if len(ssm.inputs) != 2 or ssm.attrs.get("packed"):
         return None
-    prod = {}
-    for ly in model.layers:
-        for t in ly.outputs:
-            prod[t.tensor_id] = ly
     g, u = (prod.get(t.tensor_id) for t in ssm.inputs)
     if g is None or u is None or g is u:
         return None
@@ -165,19 +173,20 @@ def _fusable_gate_up(model, ssm):
             return None
     if g.inputs[0].tensor_id != u.inputs[0].tensor_id:
         return None
-    if _sole_consumer(model, g.outputs[0]) is not ssm:
+    if _sole_consumer(model, cons, g.outputs[0]) is not ssm:
         return None
-    if _sole_consumer(model, u.outputs[0]) is not ssm:
+    if _sole_consumer(model, cons, u.outputs[0]) is not ssm:
         return None
     return g, u
 
 
 def _fuse_swiglu_mlps(model) -> int:
     n = 0
+    prod, cons = _graph_maps(model)
     for ssm in list(model.layers):
         if ssm.op_type != OpType.SIGMOID_SILU_MULTI:
             continue
-        pair = _fusable_gate_up(model, ssm)
+        pair = _fusable_gate_up(model, ssm, prod, cons)
         if pair is None:
             continue
         g, u = pair

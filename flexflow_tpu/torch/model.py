@@ -90,12 +90,19 @@ class PyTorchModel:
             from transformers.utils.fx import \
                 symbolic_trace as hf_symbolic_trace
 
-            if hasattr(module, "config"):
+            saved_use_cache = getattr(getattr(module, "config", None),
+                                      "use_cache", None)
+            if saved_use_cache is not None:
                 # traced past_key_values would double the op surface for
                 # a training-oriented translation nobody consumes
                 module.config.use_cache = False
-            self.traced = hf_symbolic_trace(module,
-                                            input_names=self.input_names)
+            try:
+                self.traced = hf_symbolic_trace(module,
+                                                input_names=self.input_names)
+            finally:
+                if saved_use_cache is not None:
+                    # tracing must not permanently mutate the USER's module
+                    module.config.use_cache = saved_use_cache
         else:
             self.traced = torch.fx.symbolic_trace(module)
         # drop dead nodes (e.g. the unused getitem(mha, 1) a tuple unpack
@@ -159,7 +166,10 @@ class PyTorchModel:
             while made.name in used:
                 made.name += "_"
             if made.name != base and node.op == "call_module":
-                self._module_renames[base] = made.name
+                # keyed by the DOTTED module path: two distinct targets can
+                # sanitize to the same base ('conv.1' and 'conv_1'), and
+                # copy_weights must route each to its own final layer name
+                self._module_renames[str(node.target)] = made.name
             used.add(made.name)
             alias[node.name] = made.name
             ir.append(made)
@@ -376,8 +386,8 @@ class PyTorchModel:
             return self._copy_weights_hf(ffmodel)
         self.to_ir()                  # populates _module_renames
         for tname, mod in self.module.named_modules():
-            name = tname.replace(".", "_")
-            name = getattr(self, "_module_renames", {}).get(name, name)
+            name = getattr(self, "_module_renames", {}).get(
+                tname, tname.replace(".", "_"))
             if isinstance(mod, nn.Linear):
                 ffmodel.set_parameter_by_key(
                     (name, "kernel"),
@@ -617,8 +627,12 @@ class _HFLowering:
                 elif node.target in tbuffers:
                     self.env[node] = ("const", tbuffers[node.target])
                 else:
-                    self.env[node] = ("const",
-                                      getattr(traced, node.target))
+                    # plain tensor attribute: dotted targets need
+                    # per-segment traversal (getattr can't resolve dots)
+                    obj = traced
+                    for seg in str(node.target).split("."):
+                        obj = getattr(obj, seg)
+                    self.env[node] = ("const", obj)
             elif node.op == "output":
                 outs = self._output_names(node.args[0])
                 self.ir.append(IRNode("output", node.name, outs, {}))

@@ -336,7 +336,8 @@ int ffgb_output(void *h, const int *ids, int n) {
     if (!valid(g, ids[i])) return -1;
     names.push_back(g->name_of(ids[i]));
   }
-  g->add("output", "output", std::move(names), "");
+  if (g->add("output", "output", std::move(names), "") < 0)
+    return -1;  // a user node claimed the name "output"
   g->has_output = true;
   return 0;
 }

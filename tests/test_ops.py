@@ -220,3 +220,37 @@ def test_dropout_train_vs_eval():
     model.compile()
     got = model.predict([x])  # eval mode: identity
     np.testing.assert_allclose(got, x)
+
+
+def test_batch_norm_large_mean_channel_stable():
+    """One-pass BN statistics are computed about the running mean: a
+    channel with |mean| >> std must still normalize to ~unit variance
+    once running stats track (raw E[x^2]-mean^2 cancels catastrophically
+    in f32 and collapses var to 0 -> rstd ~ 1/sqrt(eps))."""
+    m = ff.FFModel(ff.FFConfig(batch_size=32))
+    t = m.create_tensor([32, 4, 8, 8], ff.DataType.DT_FLOAT)
+    m.batch_norm(t, relu=False, name="bn")
+    m.compile(comp_mode=ff.CompMode.COMP_MODE_INFERENCE)
+    rng = np.random.RandomState(0)
+    x = (1e3 + 1e-2 * rng.randn(32, 4, 8, 8)).astype(np.float32)
+    # seed the running stats near the data (two training-mode passes)
+    from flexflow_tpu.ops.base import OpContext
+    import jax
+
+    ctx = OpContext(training=True, rng=jax.random.PRNGKey(0),
+                    compute_dtype=None, mesh=m.mesh, config=m.config)
+    layer = [ly for ly in m.layers if ly.name == "bn"][0]
+    from flexflow_tpu.ops.base import get_op_impl
+
+    impl = get_op_impl(layer.op_type)
+    state = m.op_state["bn"]
+    ctx.layer_name = "bn"
+    for _ in range(80):   # EMA (momentum 0.1) converges toward the batch
+        ctx.state_in = {"bn": state}
+        ctx.state_out = {}
+        (y,) = impl.forward(layer.attrs, m.params.get("bn", {}), [x], ctx)
+        state = ctx.state_out.get("bn", state)
+    y = np.asarray(y, np.float32)
+    # normalized output: ~zero mean, ~unit variance per channel
+    assert abs(float(y.mean())) < 0.2
+    assert 0.5 < float(y.std()) < 1.5

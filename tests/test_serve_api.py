@@ -152,3 +152,24 @@ def test_start_server_requires_compile(hf_llama):
     llm = ff_serve.LLM(hf_llama)
     with pytest.raises(RuntimeError, match="compile"):
         llm.start_server()
+
+
+def test_server_empty_prompt_list_returns_immediately(hf_llama):
+    """generate([]) in server mode must return [] instead of enqueueing
+    a waiter no generation round ever releases (a permanent hang)."""
+    import threading
+
+    llm = ff_serve.LLM(hf_llama)
+    llm.compile(max_requests_per_batch=2, max_seq_length=64,
+                max_tokens_per_batch=16, kv_cache_dtype="float32")
+    llm.start_server()
+    try:
+        out = {}
+        t = threading.Thread(
+            target=lambda: out.setdefault("r", llm.generate([])))
+        t.start()
+        t.join(timeout=30)
+        assert not t.is_alive(), "empty submission hung the server path"
+        assert out["r"] == []
+    finally:
+        llm.stop_server()
