@@ -1,0 +1,108 @@
+"""Bottleneck-ResNet training through the fx frontend (reference
+examples/python/pytorch/resnet152_training.py; torchvision isn't in this
+image, so the Bottleneck topology is in plain torch.nn). The block plan
+defaults to a CI-sized [1, 1, 1, 1]; pass --depth 152 for the full
+[3, 8, 36, 3] layout."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), *[_os.pardir] * 3)))
+
+import argparse
+
+import numpy as np
+import torch
+import torch.nn as nn
+
+import flexflow_tpu as ff
+from flexflow_tpu.torch.model import PyTorchModel
+
+PLANS = {50: [3, 4, 6, 3], 101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
+
+
+class Bottleneck(nn.Module):
+    expansion = 4
+
+    def __init__(self, c_in, c_mid, stride=1):
+        super().__init__()
+        c_out = c_mid * self.expansion
+        self.conv1 = nn.Conv2d(c_in, c_mid, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(c_mid)
+        self.conv2 = nn.Conv2d(c_mid, c_mid, 3, stride=stride, padding=1,
+                               bias=False)
+        self.bn2 = nn.BatchNorm2d(c_mid)
+        self.conv3 = nn.Conv2d(c_mid, c_out, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(c_out)
+        self.relu = nn.ReLU()
+        self.down = (nn.Sequential(
+            nn.Conv2d(c_in, c_out, 1, stride=stride, bias=False),
+            nn.BatchNorm2d(c_out))
+            if stride != 1 or c_in != c_out else nn.Identity())
+
+    def forward(self, x):
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.relu(self.bn2(self.conv2(y)))
+        y = self.bn3(self.conv3(y))
+        return self.relu(y + self.down(x))
+
+
+class BottleneckResNet(nn.Module):
+    def __init__(self, blocks, width=16, classes=10):
+        super().__init__()
+        self.stem = nn.Conv2d(3, width, 3, padding=1, bias=False)
+        self.bn = nn.BatchNorm2d(width)
+        self.relu = nn.ReLU()
+        stages = []
+        c_in = width
+        for si, n in enumerate(blocks):
+            c_mid = width * (2 ** si)
+            for b in range(n):
+                stages.append(Bottleneck(c_in, c_mid,
+                                         stride=2 if (b == 0 and si > 0)
+                                         else 1))
+                c_in = c_mid * Bottleneck.expansion
+        self.stages = nn.Sequential(*stages)
+        self.pool = nn.AdaptiveAvgPool2d((1, 1))
+        self.flat = nn.Flatten()
+        self.head = nn.Linear(c_in, classes)
+
+    def forward(self, x):
+        x = self.relu(self.bn(self.stem(x)))
+        x = self.stages(x)
+        return self.head(self.flat(self.pool(x)))
+
+
+def top_level_task():
+    p = argparse.ArgumentParser()
+    p.add_argument("--depth", type=int, default=0,
+                   choices=[0] + sorted(PLANS),
+                   help="50/101/152 for the full plans; 0 = CI-tiny")
+    args, rest = p.parse_known_args()
+    _sys.argv = [_sys.argv[0]] + rest
+    config = ff.FFConfig.from_args()
+    torch.manual_seed(config.seed)
+    blocks = PLANS.get(args.depth, [1, 1, 1, 1])
+    model = BottleneckResNet(blocks)
+
+    ffmodel = ff.FFModel(config)
+    t = ffmodel.create_tensor([config.batch_size, 3, 32, 32],
+                              ff.DataType.DT_FLOAT)
+    pm = PyTorchModel(model, batch_size=config.batch_size)
+    outs = pm.torch_to_ff(ffmodel, [t])
+    ffmodel.softmax(outs[0])
+    ffmodel.compile(
+        optimizer=ff.SGDOptimizer(ffmodel, lr=config.learning_rate),
+        loss_type=ff.LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[ff.MetricsType.METRICS_ACCURACY])
+    pm.copy_weights(ffmodel)           # train from the seeded torch init
+    rng = np.random.RandomState(0)
+    n = 4 * config.batch_size          # sibling-example convention
+    xs = rng.randn(n, 3, 32, 32).astype(np.float32)
+    ys = rng.randint(0, 10, size=(n, 1)).astype(np.int32)
+    ffmodel.fit(xs, ys, epochs=config.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -40,6 +40,7 @@ _SMALL_BATCH = {
     "examples/python/pytorch/regnet.py": ["-e", "1", "-b", "8"],
     "examples/python/pytorch/torch_vision.py": ["-e", "1", "-b", "8"],
     "examples/python/pytorch/resnet_torch.py": ["-e", "1", "-b", "8"],
+    "examples/python/pytorch/resnet152_training.py": ["-e", "1", "-b", "8"],
     "examples/python/pytorch/cifar10_cnn_torch.py": ["-e", "1", "-b", "8"],
     "examples/python/onnx/alexnet_onnx.py": ["-e", "1", "-b", "8"],
     "examples/python/onnx/resnet_onnx.py": ["-e", "1", "-b", "8"],
