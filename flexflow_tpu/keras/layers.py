@@ -564,7 +564,9 @@ def concatenate(inputs, axis: int = 1, **kwargs):
 # tensor arithmetic sugar (`x + y` in the reference rsqrt example).
 # Only tensor-tensor pairs are supported; a non-tensor operand returns
 # NotImplemented so Python raises a clear TypeError instead of crashing
-# deep inside layer building (and reflected ops mirror the same rule).
+# deep inside layer building. No reflected ops: Python only consults
+# them when the LEFT operand is not a KerasTensor, and that case is
+# unsupported by design.
 def _binary_sugar(layer_fn):
     def op(self, other):
         if not isinstance(other, KerasTensor):
@@ -574,7 +576,5 @@ def _binary_sugar(layer_fn):
 
 
 KerasTensor.__add__ = _binary_sugar(add)
-KerasTensor.__radd__ = _binary_sugar(lambda ins: add(ins[::-1]))
 KerasTensor.__sub__ = _binary_sugar(subtract)
 KerasTensor.__mul__ = _binary_sugar(multiply)
-KerasTensor.__rmul__ = _binary_sugar(lambda ins: multiply(ins[::-1]))

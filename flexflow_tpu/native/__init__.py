@@ -49,6 +49,7 @@ def _needs_build() -> bool:
 def _build() -> bool:
     os.makedirs(_BUILD_DIR, exist_ok=True)
     cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-shared",
+           "-I", os.path.join(_NATIVE_DIR, "include"),
            "-o", _LIB_PATH] + _sources()
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)

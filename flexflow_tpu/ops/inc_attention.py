@@ -149,8 +149,13 @@ def _qkv(attrs, params, x, compute_dtype):
         q = qmatmul(x, params["wq"])
         k = qmatmul(x, params["wk"])
         v = qmatmul(x, params["wv"])
-        if "bq" in params:
+        n_bias = sum(k_ in params for k_ in ("bq", "bk", "bv"))
+        if n_bias == 3:
             q, k, v = q + params["bq"], k + params["bk"], v + params["bv"]
+        elif n_bias:
+            raise ValueError(
+                "attention qkv bias set must be all-present or all-absent; "
+                f"got {sorted(k_ for k_ in ('bq', 'bk', 'bv') if k_ in params)}")
     R, Q = x.shape[0], x.shape[1]
     return (q.reshape(R, Q, H, D), k.reshape(R, Q, KH, D),
             v.reshape(R, Q, KH, D))

@@ -115,10 +115,15 @@ def _fuse_attention_qkv(model) -> int:
         lp = model.params.get(layer.name)
         if not lp or not all(k in lp for k in ("wq", "wk", "wv")):
             continue
+        n_bias = sum(k in lp for k in ("bq", "bk", "bv"))
+        if n_bias not in (0, 3):
+            # a partial bias set cannot be packed into one bqkv and the
+            # fused path would silently drop the stragglers — skip
+            continue
         fused = _concat_cols([lp["wq"], lp["wk"], lp["wv"]])
         if fused is None:
             continue
-        if all(k in lp for k in ("bq", "bk", "bv")):
+        if n_bias == 3:
             lp["bqkv"] = jnp.concatenate(
                 [jnp.asarray(lp[k]) for k in ("bq", "bk", "bv")])
             for k in ("bq", "bk", "bv"):
@@ -172,6 +177,10 @@ def _fusable_gate_up(model, ssm, prod, cons):
                 or set(model.params.get(ly.name, {})) != {"kernel"}):
             return None
     if g.inputs[0].tensor_id != u.inputs[0].tensor_id:
+        return None
+    if g.attrs["out_dim"] != u.attrs["out_dim"]:
+        # the packed half-split in SigmoidSiluMulti assumes equal halves;
+        # refuse fusion on a malformed graph instead of mis-splitting
         return None
     if _sole_consumer(model, cons, g.outputs[0]) is not ssm:
         return None

@@ -143,11 +143,17 @@ class InferenceManager:
                     # AOT executables reject mismatched inputs instead of
                     # retracing: validate with one all-inactive step (no
                     # KV writes, outputs unread) BEFORE adopting the
-                    # path. A failure leaves params relayouted, which
-                    # jitted fallbacks handle by retracing.
+                    # path. The executable donates its op_state argument,
+                    # so validate against a throwaway COPY — a failure
+                    # mid-execution must never delete the live buffers the
+                    # jitted fallback (and in-flight KV state) depend on.
+                    # A failure leaves params relayouted, which jitted
+                    # fallbacks handle by retracing.
                     R = cfg.max_requests_per_batch
                     z = jnp.zeros((R,), jnp.int32)
-                    _, st, _ = blk(self.model.params, self.model.op_state,
+                    state_copy = jax.tree_util.tree_map(
+                        jnp.copy, self.model.op_state)
+                    _, st, _ = blk(self.model.params, state_copy,
                                    z, z, jnp.zeros((R,), bool),
                                    jax.random.PRNGKey(0), jnp.int32(1))
                     self.model.op_state = st

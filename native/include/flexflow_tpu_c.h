@@ -159,6 +159,9 @@ int ffgb_scalar(void *handle, int in, const char *op, double scalar,
                 int reverse, const char *name);
 int ffgb_transpose(void *handle, int in, const int *perm, int ndims,
                    const char *name);
+/* Reduction dims must be unique and in [0, FFGB_MAX_DIMS); exact-rank
+ * validation happens at IR load. */
+#define FFGB_MAX_DIMS 8
 int ffgb_mean(void *handle, int in, const int *dims, int ndims,
               int keepdims, const char *name);
 /* dtype name per flexflow_tpu.ffconst.DataType values, e.g. "float32". */

@@ -19,6 +19,8 @@
 #include <string>
 #include <vector>
 
+#include "flexflow_tpu_c.h"
+
 namespace {
 
 struct Node {
@@ -306,7 +308,16 @@ int ffgb_transpose(void *h, int in, const int *perm, int ndims,
 int ffgb_mean(void *h, int in, const int *dims, int ndims, int keepdims,
               const char *name) {
   GraphBuilder *g = GB(h);
-  if (!valid(g, in) || ndims <= 0) return -1;
+  if (!valid(g, in) || ndims <= 0 || ndims > FFGB_MAX_DIMS) return -1;
+  /* The builder tracks names, not ranks, so exact-rank validation
+   * happens at IR load; still reject at the ABI boundary anything that
+   * could be silently misread via Python negative indexing (matching
+   * ffgb_transpose's eager perm validation). */
+  std::vector<bool> seen(FFGB_MAX_DIMS, false);
+  for (int i = 0; i < ndims; i++) {
+    if (dims[i] < 0 || dims[i] >= FFGB_MAX_DIMS || seen[dims[i]]) return -1;
+    seen[dims[i]] = true;
+  }
   std::ostringstream a = attr_stream();
   a << "\"dims\": [";
   for (int i = 0; i < ndims; i++) a << (i ? ", " : "") << dims[i];
