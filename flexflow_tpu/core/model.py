@@ -1036,8 +1036,12 @@ class FFModel:
          step_metrics) = self._train_step(self.params, self.opt_state,
                                           self.op_state, feeds, label, step_rng)
         if self.config.profiling:
-            # --profiling parity: per-step device-fenced timing print
-            jax.block_until_ready(loss)
+            # --profiling parity: per-step timing, fenced by host
+            # readback (block_until_ready is not a fence on the
+            # axon-tunneled TPU — utils/profiling.device_fence)
+            from flexflow_tpu.utils.profiling import device_fence
+
+            device_fence(loss)
             self._step_timer.record("train_step",
                                     _time.perf_counter() - t0)
         bs = y.shape[0]

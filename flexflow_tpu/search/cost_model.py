@@ -357,16 +357,31 @@ class CostModel:
     def measure_node(self, node: PCGNode, st: OpStrategy) -> float:
         """Compile+time the op's jax forward on the real backend, cached by
         (op, shapes, sharding) — reference Op::measure_operator_cost
-        (e.g. linear.cc:1163) with the params-hash cache in simulator.cc."""
+        (e.g. linear.cc:1163) with the params-hash cache in simulator.cc.
+
+        Timing uses the readback-fenced T-slope protocol (PARITY.md
+        round-4 measurement record; utils/profiling.slope_time):
+        ``jax.block_until_ready`` is NOT a fence on the axon-tunneled
+        TPU, and single-call timings measure ~10 ms of dispatch latency
+        instead of the op. The op runs T iterations inside ONE jitted
+        ``lax.fori_loop`` whose body derives its inputs from the loop
+        carry (so XLA cannot hoist the work out of the loop), the final
+        scalar carry is read back to the host as the fence, and the
+        per-iteration time is the slope between an adaptively-grown
+        trip count and the T=1 baseline (the per-call jitter scales
+        with the ~80-100 ms tunnel dispatch cost, so the trip spread
+        must grow until the compute delta clears it). A non-positive
+        slope (op too fast to resolve over dispatch jitter) falls back
+        to the analytic roofline — never a noise ranking.
+        """
         key = f"{node.op_type}:{node.input_shapes}:{st.key()}"
         if key in self._profile_cache:
             return self._profile_cache[key]
-        import time
-
         import jax
         import jax.numpy as jnp
 
         from flexflow_tpu.ops.base import OpContext, get_op_impl
+        from flexflow_tpu.utils.profiling import adaptive_slope_time
 
         try:
             impl = get_op_impl(node.op_type)
@@ -386,17 +401,34 @@ class CostModel:
                       for w, s in node.weight_shapes.items()}
             ctx = OpContext(training=False, compute_dtype=jnp.float32)
 
-            def f(params, ins):
-                return impl.forward(node.attrs, params, ins, ctx)
+            def f(params, ins, trips):
+                def body(_, carry):
+                    # derive inputs from the carry: each iteration depends
+                    # on the previous one, so the loop cannot be hoisted
+                    # or collapsed by LICM/CSE
+                    shifted = [x + carry.astype(x.dtype) for x in ins]
+                    outs = impl.forward(node.attrs, params, shifted, ctx)
+                    leaves = [ell for ell in jax.tree_util.tree_leaves(outs)
+                              if hasattr(ell, "dtype")]
+                    s = sum(jnp.mean(ell.astype(jnp.float32))
+                            for ell in leaves)
+                    # tiny non-zero factor: keeps a real data dependence
+                    # on the op's outputs (0.0 * s would fold away) while
+                    # leaving the carry ~0 so inputs stay unperturbed
+                    return carry + s * jnp.float32(1e-30)
+
+                return jax.lax.fori_loop(0, trips, body, jnp.float32(0.0))
 
             jf = jax.jit(f)
-            out = jf(params, ins)
-            jax.block_until_ready(out)
-            t0 = time.perf_counter()
-            for _ in range(3):
-                out = jf(params, ins)
-            jax.block_until_ready(out)
-            t = (time.perf_counter() - t0) / 3 / shards
+
+            def run(trips):
+                # np.asarray on the scalar carry = host readback fence
+                return np.asarray(jf(params, ins, jnp.int32(trips)))
+
+            run(1)                                    # compile + warm
+            t = adaptive_slope_time(run) / shards
+            if t <= 0.0:
+                t = self.node_compute_time(node, st).forward_time
         except Exception:
             t = self.node_compute_time(node, st).forward_time
         self._profile_cache[key] = t

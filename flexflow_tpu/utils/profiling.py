@@ -2,18 +2,118 @@
 
 Capability parity with the reference's two profiling layers (SURVEY §5):
 (a) ``--profiling`` per-kernel cudaEvent timing prints → here per-step
-wall-time with ``block_until_ready`` fencing, and (b) Legion Prof traces →
+wall-time with host-readback fencing, and (b) Legion Prof traces →
 here the XLA/jax profiler (``jax.profiler.trace``) whose output loads in
 TensorBoard / Perfetto.
+
+Measurement protocol (PARITY.md round-4 record): on the axon-tunneled
+TPU, ``jax.block_until_ready`` can return BEFORE device execution
+finishes and must not be used as a timing fence.  The only honest fence
+is a device→host readback (``device_fence``).  Single-call timings also
+include ~10 ms of dispatch latency; ``slope_time`` cancels it by running
+T1 and T2 iterations inside ONE device program and taking the slope.
 """
 
 from __future__ import annotations
 
 import contextlib
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import jax
+import numpy as np
+
+
+def device_fence(out):
+    """Block until ``out`` has actually been computed, by reading one
+    element of every array leaf back to the host.
+
+    ``jax.block_until_ready`` is NOT used: through the axon remote
+    tunnel it returns before device execution completes (measured in
+    round 4 — it produced an 8.9x-of-spec "bandwidth"). A host readback
+    of any output buffer cannot complete until the producing program
+    has finished, so it is the honest fence. Only a single element per
+    leaf crosses the wire. Returns ``out``.
+    """
+    import jax.numpy as jnp
+
+    scalars = [jnp.ravel(leaf)[0].astype(jnp.float32)
+               for leaf in jax.tree_util.tree_leaves(out)
+               if hasattr(leaf, "dtype") and getattr(leaf, "size", 0)]
+    if scalars:
+        # the element extractions dispatch asynchronously; ONE stacked
+        # readback fences them all (N synchronous readbacks would each
+        # pay the full tunnel round trip inside a timed window)
+        np.asarray(jnp.stack(scalars))
+    return out
+
+
+def timed_call(fn, *args, **kwargs):
+    """Run fn, fence its outputs via host readback, return (result, s)."""
+    t0 = time.perf_counter()
+    out = fn(*args, **kwargs)
+    device_fence(out)
+    return out, time.perf_counter() - t0
+
+
+def slope_time(run: Callable[[int], object], t1: int = 1, t2: int = 5,
+               reps: int = 2) -> float:
+    """Per-iteration time of ``run(T)`` via the T-slope protocol.
+
+    ``run(T)`` must execute T iterations of the workload inside ONE
+    device program (e.g. a jitted ``lax.fori_loop`` with a traced trip
+    count) and block until done (readback-fence its result).  The slope
+    ``(time(t2) - time(t1)) / (t2 - t1)`` cancels both the per-dispatch
+    latency (~80-100 ms through the axon tunnel) and any fixed per-call
+    cost.  Each trip count is timed ``reps`` times and the best
+    (minimum) is used.  Returns seconds per iteration; may be <= 0
+    under jitter — callers should treat that as "too fast to resolve"
+    and fall back.
+    """
+    best = {}
+    for t in (t1, t2):
+        best[t] = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            run(t)
+            best[t] = min(best[t], time.perf_counter() - t0)
+    return (best[t2] - best[t1]) / (t2 - t1)
+
+
+def adaptive_slope_time(run: Callable[[int], object], cap: int = 4096,
+                        reps: int = 3, min_resolve_s: float = 5e-3) -> float:
+    """T-slope with an adaptively chosen upper trip count.
+
+    The per-call jitter on the tunneled TPU scales with the ~80-100 ms
+    fixed dispatch+readback cost (measured: min-of-reps stable to a few
+    ms, with occasional +40 ms outliers), so a fixed small T2 cannot
+    resolve micro/millisecond ops.  This grows the trip count by 4x
+    until the extra compute clears a noise floor of
+    ``max(0.5 * fixed_cost, min_resolve_s)``, then returns the slope
+    against the T=1 baseline.  Each level is timed ``reps`` times, best
+    (minimum) kept.  Returns 0.0 when the workload is too fast to
+    resolve even at ``cap`` trips (the delta there is indistinguishable
+    from jitter) — callers must fall back to an analytic estimate
+    rather than rank on noise.
+    """
+    def best_of(t):
+        b = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            run(t)
+            b = min(b, time.perf_counter() - t0)
+        return b
+
+    t_fix = best_of(1)
+    thresh = max(0.5 * t_fix, min_resolve_s)
+    t = 8
+    while True:
+        t_hi = best_of(t)
+        if t_hi - t_fix >= thresh:
+            return (t_hi - t_fix) / (t - 1)
+        if t >= cap:
+            return 0.0          # never resolved above the noise floor
+        t = min(t * 4, cap)
 
 
 class StepTimer:
@@ -50,11 +150,3 @@ def profiler_trace(logdir: str):
         yield
     finally:
         jax.profiler.stop_trace()
-
-
-def timed_call(fn, *args, **kwargs):
-    """Run fn, block on its outputs, return (result, seconds)."""
-    t0 = time.perf_counter()
-    out = fn(*args, **kwargs)
-    jax.block_until_ready(out)
-    return out, time.perf_counter() - t0

@@ -236,6 +236,56 @@ def test_network_topologies_and_routing():
     assert ar > 0
 
 
+def test_device_fence_and_slope_time():
+    """The measurement primitives behind measure_node (PARITY r4
+    protocol): device_fence reads back every leaf; slope_time recovers a
+    per-iteration cost with fixed per-call overhead cancelled."""
+    import time
+
+    import jax.numpy as jnp
+
+    from flexflow_tpu.utils.profiling import device_fence, slope_time
+
+    out = {"a": jnp.arange(4.0), "b": (jnp.ones((2, 2)),)}
+    assert device_fence(out) is out
+
+    per_iter = 2e-3
+    def run(trips):
+        time.sleep(5e-3 + per_iter * trips)   # fixed cost + linear part
+    t = slope_time(run, t1=1, t2=5, reps=2)
+    # sleep jitter only ever ADDS time; bound loosely for loaded CI hosts
+    assert 0 < t < 3 * per_iter               # fixed 5 ms cancelled
+
+    from flexflow_tpu.utils.profiling import adaptive_slope_time
+    t = adaptive_slope_time(run, reps=1)
+    assert 0 < t < 3 * per_iter
+    # a zero-cost workload must report "unresolvable" (0.0), not noise
+    assert adaptive_slope_time(lambda trips: None, cap=8, reps=1,
+                               min_resolve_s=10.0) == 0.0
+
+
+def test_measure_node_slope_protocol_cpu():
+    """measure_node must time via the fori_loop slope program (not
+    per-call dispatch), produce a positive cached time for a real op,
+    and fall back to the analytic roofline on un-runnable nodes."""
+    from flexflow_tpu.search.cost_model import CostModel
+    from flexflow_tpu.search.machine_model import MachineModel
+    from flexflow_tpu.search.pcg import PCG
+
+    model = _small_model()
+    model.compile()
+    pcg = PCG.from_model(model)
+    axes = {"data": 2, "model": 4}
+    cm = CostModel(MachineModel.from_name("v5e", 8), axes, training=False)
+    node = next(n for n in pcg.nodes if n.weight_shapes)
+    st = node.candidates(axes)[0]
+    t = cm.measure_node(node, st)
+    assert t > 0.0
+    assert cm._profile_cache            # cached under (op, shapes, sharding)
+    # cache hit: identical value, no re-measure
+    assert cm.measure_node(node, st) == t
+
+
 def test_profiler_trace(tmp_path):
     from flexflow_tpu.utils.profiling import profiler_trace
 
