@@ -59,6 +59,12 @@ class FFConfig:
     # times (reference Op::measure_operator_cost). None = on for real
     # accelerators, off on the CPU simulator.
     search_profile: Optional[bool] = None
+    # also search the mesh FACTORIZATION (every data x model split of the
+    # device count) instead of pinning the user's dp/tp degrees — the
+    # reference covers this dimension through MachineView degrees
+    # (graph.cc:2107). Opt-in: it multiplies search time by the number of
+    # factorizations and compile() adopts the winning degrees.
+    search_mesh: bool = False
     # memory-aware search (reference graph.cc:2126 lambda binary search)
     mem_search_budget: int = -1
     # inter-slice (DCN) fabric for the search's cost model: a

@@ -85,6 +85,7 @@ class FFModel:
         self.mesh = None
         self.policy: Optional[ShardingPolicy] = None
         self.strategy = None    # search/strategy.py Strategy when auto_parallel
+        self._branch_plan = None
         self._train_step = None
         self._eval_step = None
         self._perf = PerfMetrics()
@@ -724,7 +725,24 @@ class FFModel:
         values: Dict[int, Any] = dict(feeds)
         ctx.state_in = state or {}
         ctx.state_out = {}
+        plan = getattr(self, "_branch_plan", None)
         for layer in self.layers:
+            if plan is not None:
+                if layer.name in plan.skip:
+                    continue            # executed inside its branch region
+                region = plan.by_join.get(layer.name)
+                if region is not None:
+                    from flexflow_tpu.core.branch_exec import \
+                        run_branch_region
+
+                    if run_branch_region(self, region, params, values, ctx):
+                        continue        # join output written by the region
+                    # runtime fallback (e.g. batch not splittable): run
+                    # the deferred branch layers sequentially, then the
+                    # join itself below
+                    for chain in region.chains:
+                        for ly in chain:
+                            self._apply_layer(ly, params, values, ctx)
             self._apply_layer(layer, params, values, ctx)
         new_state = dict(ctx.state_in)
         new_state.update(ctx.state_out)
@@ -765,6 +783,16 @@ class FFModel:
             self.strategy = optimize_model(
                 self, chip=self.config.tpu_chip,
                 training=(comp_mode == CompMode.COMP_MODE_TRAINING))
+        if (self.strategy is not None
+                and self.strategy.axis_degrees is not None):
+            # the search explored mesh factorizations (search_mesh) and a
+            # different one won: adopt its degrees and rebuild the mesh
+            deg = self.strategy.axis_degrees
+            self.config.data_parallelism_degree = deg.get("data", 1)
+            self.config.tensor_parallelism_degree = deg.get("model", 1)
+            self.config.expert_parallelism_degree = deg.get("expert", 1)
+            self.mesh = make_mesh(self.config)
+            self.policy = ShardingPolicy(self.mesh)
         if self.config.export_strategy_file:
             # dot export of the (searched) computation graph (reference
             # --export-strategy-computation-graph-file, model.cc:4218)
@@ -840,6 +868,13 @@ class FFModel:
         self.op_state = jax.tree.map(
             lambda x: jax.device_put(x, self.policy.replicated()),
             self.op_state)
+
+        # --- branch-parallel (nonsequence split) execution plan: turn the
+        # searched OpStrategy.branch tags into shard_map regions so the
+        # split is executed, not just annotated (core/branch_exec.py) ---
+        from flexflow_tpu.core.branch_exec import build_branch_plan
+
+        self._branch_plan = build_branch_plan(self)
 
         # --- label tensor (reference compile creates it from final output) ---
         final = self.layers[-1].outputs[0] if self.layers else None

@@ -168,3 +168,62 @@ def branch_parallel_apply(mesh, axis, branch_fns, out_channels, x):
     out = jax.shard_map(local, mesh=mesh, in_specs=P(), out_specs=P(),
                         check_vma=False)(x)
     return [out[i, :, :c] for i, c in enumerate(out_channels)]
+
+
+def branch_data_parallel_apply(mesh, axis, branch_fns, branch_params,
+                               out_channels, x):
+    """Nonsequence-split execution with data parallelism INSIDE each
+    branch slice — the form the search's cost model actually assumes
+    (search/graph_search.py _try_nonsequence_splits re-optimizes each
+    branch under data degree d//nb).
+
+    The ``axis`` (size d) is viewed as nb slices of k = d // nb devices.
+    Device j runs branch ``j // k`` on batch rows
+    ``[(j % k) * B/k, (j % k + 1) * B/k)``, so per-device FLOPs equal
+    pure DP while each device executes only ITS branch's ops at an
+    nb-times larger per-op batch — the regime where nonsequence splits
+    win (many small ops whose per-op overhead dominates; reference
+    NonsequenceSplit, include/flexflow/graph.h:156). Branch outputs are
+    zero-padded on dim 1 to a common width, all-gathered once, and
+    returned per-branch at full batch with true channel counts.
+
+    ``branch_fns[i]`` takes ``(x_local, branch_params[i])``; params ride
+    in replicated (their grads psum over the axis via the shard_map
+    transpose, matching DP grad sync). Requires ``d % nb == 0`` and
+    ``B % (d // nb) == 0``; the caller falls back to sequential
+    execution otherwise."""
+    import jax.numpy as jnp
+
+    nb = len(branch_fns)
+    d = mesh.shape[axis]
+    assert d % nb == 0, (d, nb)
+    k = d // nb
+    B = x.shape[0]
+    assert B % k == 0, (B, k)
+    mb = B // k
+    cmax = max(out_channels)
+
+    def padded(f, c, i):
+        def g(operand):
+            xl, bp = operand
+            y = f(xl, bp[i])
+            pad = [(0, 0)] * y.ndim
+            pad[1] = (0, cmax - c)
+            return jnp.pad(y, pad)
+        return g
+
+    fns = [padded(f, c, i)
+           for i, (f, c) in enumerate(zip(branch_fns, out_channels))]
+
+    def local(xf, bp):
+        j = jax.lax.axis_index(axis)
+        xl = jax.lax.dynamic_slice_in_dim(xf, (j % k) * mb, mb, axis=0)
+        y = jax.lax.switch(j // k, fns, (xl, bp))   # [mb, Cmax, ...]
+        g = jax.lax.all_gather(y, axis)             # [d, mb, Cmax, ...]
+        # device order along the axis is j = branch * k + shard, so the
+        # leading [d, mb] axes reshape to per-branch full batches
+        return g.reshape((nb, k * mb) + g.shape[2:])
+
+    out = jax.shard_map(local, mesh=mesh, in_specs=(P(), P()),
+                        out_specs=P(), check_vma=False)(x, tuple(branch_params))
+    return [out[i, :, :c] for i, c in enumerate(out_channels)]

@@ -26,11 +26,15 @@ from flexflow_tpu.search.strategy import OpStrategy, Strategy
 from flexflow_tpu.search.cost_model import CostModel, CostMetrics
 from flexflow_tpu.search.pcg import PCG, PCGNode
 from flexflow_tpu.search.graph_search import (
-    UnitySearch, mcmc_optimize, optimize_model,
+    UnitySearch, data_parallel_model_strategy, mcmc_optimize, optimize_model,
+)
+from flexflow_tpu.search.measure import (
+    format_ab, searched_vs_dp_wallclock, wallclock_train,
 )
 
 __all__ = [
     "TPU_CHIPS", "ChipSpec", "MachineModel", "OpStrategy", "Strategy",
     "CostModel", "CostMetrics", "PCG", "PCGNode", "UnitySearch",
-    "mcmc_optimize", "optimize_model",
+    "mcmc_optimize", "optimize_model", "data_parallel_model_strategy",
+    "searched_vs_dp_wallclock", "wallclock_train", "format_ab",
 ]

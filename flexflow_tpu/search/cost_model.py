@@ -55,7 +55,7 @@ class CostMetrics:
 class CostModel:
     def __init__(self, machine: MachineModel, axis_degrees: Dict[str, int],
                  training: bool = True, profile: bool = False,
-                 overlap: bool = True):
+                 overlap: bool = True, branch_concurrency: bool = False):
         self.machine = machine
         self.axes = dict(axis_degrees)
         self.training = training
@@ -66,6 +66,22 @@ class CostModel:
         # branch-parallel subgraphs running concurrently — are costed
         # honestly. False: the legacy serial sum.
         self.overlap = overlap
+        # branch_concurrency=True: branch-pinned (nonsequence split) ops
+        # run on concurrent per-branch timelines — the reference's Legion
+        # per-branch MachineView semantics
+        # (find_optimal_nonsequence_graph_time, graph.h:181-196), where
+        # disjoint device subsets really do run different tasks. False
+        # (default): cost the form XLA SPMD can actually EXECUTE —
+        # device-dependent control flow lowers to every device running
+        # EVERY branch (measured round 5: a shard_map lax.switch over N
+        # conv branches costs >= N x one branch on the virtual mesh; see
+        # PARITY.md), so branch ops serialize on the shared compute
+        # timeline while still paying their scaled-axes durations. Under
+        # this honest costing a nonsequence split only wins when per-op
+        # overheads dominate, which XLA's op-level scheduling already
+        # eliminates — the search therefore keeps DP for compute-dense
+        # fork-joins, matching the measured wall-clock A/B.
+        self.branch_concurrency = branch_concurrency
         self._profile_cache: Dict[str, float] = {}
 
     def _axes_for(self, st: OpStrategy) -> Dict[str, int]:
@@ -249,6 +265,8 @@ class CostModel:
         comm_free: Dict[str, float] = {"ici": 0.0, "dcn": 0.0}
 
         def run_comp(branch, ready: float, dur: float) -> float:
+            if branch is not None and not self.branch_concurrency:
+                branch = None        # SPMD-executable: all devices run it
             if branch is None:
                 start = max(ready, max(comp_free.values()))
                 end = start + dur
@@ -286,7 +304,9 @@ class CostModel:
                 out_ready[node.idx] = 0.0
                 continue
             m = metrics_of(node, st)
-            if st.branch is None:
+            if st.branch is None or not self.branch_concurrency:
+                # SPMD-executable form: every device materializes every
+                # branch, so branch memory is base memory
                 base_mem += m.memory
             else:
                 bi = st.branch[0]

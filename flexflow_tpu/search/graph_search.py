@@ -38,7 +38,8 @@ class UnitySearch:
                  axis_degrees: Dict[str, int], beam_width: int = 32,
                  budget: int = -1, alpha: float = 1.2,
                  mem_lambda: float = 0.0, rules=None,
-                 enable_substitutions: bool = True):
+                 enable_substitutions: bool = True,
+                 enable_nonsequence: bool = True):
         self.pcg = pcg
         self.cm = cost_model
         self.axes = dict(axis_degrees)
@@ -50,6 +51,9 @@ class UnitySearch:
         self.alpha = alpha
         self.mem_lambda = mem_lambda
         self.enable_substitutions = enable_substitutions
+        # sequence-only ablation switch: skip nonsequence (branch) splits
+        # entirely (reference SplitType, include/flexflow/graph.h:156)
+        self.enable_nonsequence = enable_nonsequence
         self.rules = rules
         # graph the winning strategy is keyed on (== pcg unless a
         # substitution won)
@@ -164,6 +168,8 @@ class UnitySearch:
         if dp is not None and dp.cost + self.mem_lambda * dp.peak_memory < \
                 strategy.cost + self.mem_lambda * strategy.peak_memory:
             strategy = dp
+        if not self.enable_nonsequence:
+            return strategy
         return self._try_nonsequence_splits(pcg, strategy)
 
     def _try_nonsequence_splits(self, pcg: PCG,
@@ -196,9 +202,10 @@ class UnitySearch:
             scaled["data"] = d // nb
             trial = Strategy(ops=dict(best.ops))
             saved_cm, saved_axes, saved_pcg = self.cm, self.axes, self.pcg
-            self.cm = CostModel(saved_cm.machine, scaled,
-                                training=saved_cm.training,
-                                overlap=saved_cm.overlap)
+            self.cm = CostModel(
+                saved_cm.machine, scaled, training=saved_cm.training,
+                overlap=saved_cm.overlap,
+                branch_concurrency=saved_cm.branch_concurrency)
             self.axes = scaled
             self.pcg = pcg               # _candidate_delta reads producers
             try:
@@ -392,19 +399,10 @@ def mcmc_optimize(pcg: PCG, cost_model: CostModel,
     return best
 
 
-def optimize_model(model, chip: str = "cpu-sim",
-                   num_devices: Optional[int] = None,
-                   training: bool = True,
-                   mcmc_budget: Optional[int] = None) -> Strategy:
-    """Entry point — reference FFModel::graph_optimize via
-    GRAPH_OPTIMIZE_TASK (model.cc:3327). Reads parallelism axes from the
-    model's config, builds PCG + cost model, runs DP+beam then MCMC, and
-    re-searches with growing memory λ if HBM oversubscribes."""
-    config = model.config
-    n = num_devices if num_devices is not None else config.resolve_num_devices()
-    # multi-node runs split the devices into num_nodes slices: mesh-axis
-    # groups larger than a slice pay DCN (optionally through a routed
-    # dcn_topology's bottleneck) instead of ICI in the cost model
+def _machine_for(config, chip: str, n: int) -> MachineModel:
+    """Machine model with the config's multi-node geometry: num_nodes
+    splits the devices into slices (mesh-axis groups larger than a slice
+    pay DCN, optionally through a routed dcn_topology's bottleneck)."""
     per_slice = (n // config.num_nodes
                  if config.num_nodes and config.num_nodes > 1 else None)
     dcn_model = None
@@ -412,16 +410,40 @@ def optimize_model(model, chip: str = "cpu-sim",
         from flexflow_tpu.search.network import NetworkedMachineModel
 
         dcn_model = NetworkedMachineModel(config.dcn_topology)
-    machine = MachineModel.from_name(chip, n, devices_per_slice=per_slice,
-                                     dcn_model=dcn_model)
-    axes = {"data": config.data_parallelism_degree,
-            "model": config.tensor_parallelism_degree,
-            "expert": config.expert_parallelism_degree}
+    return MachineModel.from_name(chip, n, devices_per_slice=per_slice,
+                                  dcn_model=dcn_model)
+
+
+def optimize_model(model, chip: str = "cpu-sim",
+                   num_devices: Optional[int] = None,
+                   training: bool = True,
+                   mcmc_budget: Optional[int] = None,
+                   enable_nonsequence: bool = True,
+                   search_mesh: Optional[bool] = None) -> Strategy:
+    """Entry point — reference FFModel::graph_optimize via
+    GRAPH_OPTIMIZE_TASK (model.cc:3327). Reads parallelism axes from the
+    model's config, builds PCG + cost model, runs DP+beam then MCMC, and
+    re-searches with growing memory λ if HBM oversubscribes.
+
+    ``search_mesh`` (default ``config.search_mesh``): also search the
+    MESH FACTORIZATION — every (data x model) split of the device count
+    is searched and the cheapest strategy wins, with its winning axes
+    recorded in ``Strategy.axis_degrees`` for compile to adopt. The
+    reference's search covers this dimension through MachineView degrees
+    (graph.cc:2107); with a fixed factorization the search cannot e.g.
+    prefer pure DP over the user's dp x tp mesh even when DP is cheaper
+    (measured on BERT-tiny: the dp4 x tp2 hybrid loses to dp8 by wall
+    clock, PARITY.md round-5 record)."""
+    config = model.config
+    n = num_devices if num_devices is not None else config.resolve_num_devices()
+    machine = _machine_for(config, chip, n)
+    cfg_axes = {"data": config.data_parallelism_degree,
+                "model": config.tensor_parallelism_degree,
+                "expert": config.expert_parallelism_degree}
     if config.only_data_parallel:
-        axes["model"] = 1
-        axes["expert"] = 1
+        cfg_axes["model"] = 1
+        cfg_axes["expert"] = 1
     pcg = PCG.from_model(model)
-    cm = CostModel(machine, axes, training=training)
     budget = config.search_budget
     rules = None
     if config.substitution_json_path:
@@ -430,47 +452,6 @@ def optimize_model(model, chip: str = "cpu-sim",
 
         rules = builtin_rules() + load_rules_json(
             config.substitution_json_path)
-    lam = 0.0
-    strategy = None
-    graph = pcg
-    cand_graphs = None
-    for _attempt in range(6):
-        cm_l = CostModel(machine, axes, training=training)
-        search = UnitySearch(pcg, cm_l, axes, budget=budget,
-                             alpha=config.search_alpha, mem_lambda=lam,
-                             rules=rules,
-                             enable_substitutions=config.enable_substitutions)
-        if cand_graphs is None:
-            # first attempt: full joint rewrite discovery
-            strategy = search.optimize()
-            graph = search.best_graph
-            # keep only the best few graphs for λ retries: each retry runs
-            # a full DP per graph, so re-scoring the whole discovered pool
-            # would multiply search cost ~budget× exactly when memory
-            # pressure already makes compile slow
-            cand_graphs = [g for _, g, _ in sorted(
-                search.top_candidates, key=lambda c: c[0])[:8]]
-        else:
-            # λ retries: the rewrite pool is λ-independent — only re-score
-            # the already-discovered graphs under the new memory pressure
-            scored = []
-            for g in cand_graphs:
-                s = search.optimize_graph(g)
-                scored.append((s.cost + lam * s.peak_memory, g, s))
-            scored.sort(key=lambda c: c[0])
-            _, graph, strategy = scored[0]
-            search.best_graph = graph
-            search.top_candidates = [(s.cost, g, s) for _, g, s in scored]
-        if strategy.peak_memory <= machine.memory_per_device() or lam > 1e6:
-            break
-        lam = max(lam * 8, 1e-9)     # grow λ until the strategy fits HBM
-    candidates = list(search.top_candidates)
-    n_mcmc = mcmc_budget if mcmc_budget is not None else (
-        budget if budget > 0 else 100)
-    strategy = mcmc_optimize(graph, cm, axes, strategy, budget=n_mcmc,
-                             seed=config.seed,
-                             memory_bound=machine.memory_per_device())
-    candidates.append((strategy.cost, graph, strategy))
     # profiled re-rank (reference measure_operator_cost): default on when a
     # real accelerator backs jax, off on the CPU simulator
     profile = config.search_profile
@@ -478,15 +459,105 @@ def optimize_model(model, chip: str = "cpu-sim",
         import jax
 
         profile = jax.default_backend() != "cpu"
-    if profile:
-        # never let the re-rank resurrect a strategy the λ search rejected
-        # for oversubscribing HBM
-        fit = [c for c in candidates
-               if c[2].peak_memory <= machine.memory_per_device()]
-        graph, strategy = profile_rerank(fit or candidates, cm)
-    # a substitution may have won: expand fused nodes' strategies back onto
-    # the original layer names compile() looks up
-    strategy = expand_strategy(graph, strategy)
+
+    def search_under(axes: Dict[str, int]) -> Strategy:
+        cm = CostModel(machine, axes, training=training)
+        lam = 0.0
+        strategy = None
+        graph = pcg
+        cand_graphs = None
+        for _attempt in range(6):
+            cm_l = CostModel(machine, axes, training=training)
+            search = UnitySearch(
+                pcg, cm_l, axes, budget=budget,
+                alpha=config.search_alpha, mem_lambda=lam, rules=rules,
+                enable_substitutions=config.enable_substitutions,
+                enable_nonsequence=enable_nonsequence)
+            if cand_graphs is None:
+                # first attempt: full joint rewrite discovery
+                strategy = search.optimize()
+                graph = search.best_graph
+                # keep only the best few graphs for λ retries: each retry
+                # runs a full DP per graph, so re-scoring the whole
+                # discovered pool would multiply search cost ~budget×
+                # exactly when memory pressure already makes compile slow
+                cand_graphs = [g for _, g, _ in sorted(
+                    search.top_candidates, key=lambda c: c[0])[:8]]
+            else:
+                # λ retries: the rewrite pool is λ-independent — only
+                # re-score the discovered graphs under the new pressure
+                scored = []
+                for g in cand_graphs:
+                    s = search.optimize_graph(g)
+                    scored.append((s.cost + lam * s.peak_memory, g, s))
+                scored.sort(key=lambda c: c[0])
+                _, graph, strategy = scored[0]
+                search.best_graph = graph
+                search.top_candidates = [(s.cost, g, s)
+                                         for _, g, s in scored]
+            if strategy.peak_memory <= machine.memory_per_device() \
+                    or lam > 1e6:
+                break
+            lam = max(lam * 8, 1e-9)  # grow λ until the strategy fits HBM
+        candidates = list(search.top_candidates)
+        n_mcmc = mcmc_budget if mcmc_budget is not None else (
+            budget if budget > 0 else 100)
+        strategy = mcmc_optimize(graph, cm, axes, strategy, budget=n_mcmc,
+                                 seed=config.seed,
+                                 memory_bound=machine.memory_per_device())
+        candidates.append((strategy.cost, graph, strategy))
+        if profile:
+            # never let the re-rank resurrect a strategy the λ search
+            # rejected for oversubscribing HBM
+            fit = [c for c in candidates
+                   if c[2].peak_memory <= machine.memory_per_device()]
+            graph, strategy = profile_rerank(fit or candidates, cm)
+        # a substitution may have won: expand fused nodes' strategies back
+        # onto the original layer names compile() looks up
+        strategy = expand_strategy(graph, strategy)
+        strategy.axis_degrees = dict(axes)
+        return strategy
+
+    do_mesh = (config.search_mesh if search_mesh is None else search_mesh)
+    factorizations = [cfg_axes]
+    if do_mesh and cfg_axes["expert"] <= 1 and not config.only_data_parallel:
+        for d in range(1, n + 1):
+            if n % d != 0:
+                continue
+            cand = {"data": d, "model": n // d, "expert": 1}
+            if cand not in factorizations:
+                factorizations.append(cand)
+    searched = [search_under(a) for a in factorizations]
+    # never adopt a factorization whose λ search gave up over HBM when a
+    # fitting one exists (the single-factorization path's "never
+    # resurrect an HBM-rejected strategy" guard, applied across meshes)
+    fits = [s for s in searched
+            if s.peak_memory <= machine.memory_per_device()]
+    strategy = min(fits or searched, key=lambda s: s.cost)
+    if strategy.axis_degrees == cfg_axes:
+        strategy.axis_degrees = None     # nothing for compile to adopt
     if config.export_strategy_file:
         strategy.save(config.export_strategy_file)
     return strategy
+
+
+def data_parallel_model_strategy(model, chip: str = "cpu-sim",
+                                 num_devices: Optional[int] = None,
+                                 training: bool = True) -> Optional[Strategy]:
+    """The canonical pure-DP strategy for ``model``, scored (not searched)
+    under the analytic cost model — the reference's
+    get_basic_data_parallel_config (model.h:303), exposed so a measured
+    searched-vs-DP A/B can compile BOTH placements through the same
+    runtime (search/measure.py)."""
+    config = model.config
+    n = num_devices if num_devices is not None else \
+        config.resolve_num_devices()
+    machine = _machine_for(config, chip, n)   # same geometry as the search
+    # canonical DP = batch over ALL devices, model/expert axes unused
+    axes = {"data": n, "model": 1, "expert": 1}
+    pcg = PCG.from_model(model)
+    search = UnitySearch(pcg, CostModel(machine, axes, training=training),
+                         axes, enable_substitutions=False,
+                         enable_nonsequence=False)
+    dp = search._dp_baseline(pcg)
+    return expand_strategy(pcg, dp) if dp is not None else None

@@ -74,6 +74,10 @@ class Strategy:
     ops: Dict[str, OpStrategy] = dataclasses.field(default_factory=dict)
     cost: float = float("inf")           # simulated step time (s)
     peak_memory: float = 0.0             # per-device bytes
+    # mesh factorization this strategy was searched under (set when the
+    # search explored factorizations — the reference searches MachineView
+    # degrees too, graph.cc:2107); compile applies it to the config
+    axis_degrees: Optional[Dict[str, int]] = None
 
     def to_json(self) -> str:
         def enc(s: OpStrategy):
@@ -87,6 +91,8 @@ class Strategy:
             }
 
         return json.dumps({"cost": self.cost, "peak_memory": self.peak_memory,
+                           **({"axis_degrees": self.axis_degrees}
+                              if self.axis_degrees else {}),
                            "ops": {k: enc(v) for k, v in self.ops.items()}},
                           indent=2)
 
@@ -106,7 +112,8 @@ class Strategy:
 
         return cls(ops={k: dec(v) for k, v in raw["ops"].items()},
                    cost=raw.get("cost", float("inf")),
-                   peak_memory=raw.get("peak_memory", 0.0))
+                   peak_memory=raw.get("peak_memory", 0.0),
+                   axis_degrees=raw.get("axis_degrees"))
 
     def save(self, path: str):
         with open(path, "w") as f:

@@ -1,0 +1,201 @@
+"""Python host for the native C serving ABI (``ffsv_*``).
+
+The reference's C API wraps config creation, model build, weight load,
+request registration and generation so a non-Python host can embed the
+whole system (reference src/c/flexflow_c.cc — 2,678 LoC;
+``flexflow_model_generate`` at :1584 is what the C++ serving mains drive,
+inference/incr_decoding/incr_decoding.cc:118). Here the runtime is
+Python+XLA, so the native layer (native/src/serve_c.cpp) embeds CPython
+and calls the flat functions in this module — the same
+runtime-behind-a-C-ABI architecture the reference has with Legion behind
+flexflow_c, with the interpreter playing Legion's role.
+
+Every function takes/returns only simple types (str/int/lists/opaque
+objects) so the C side needs no Python type knowledge beyond
+PyObject_CallMethod.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+
+def _maybe_force_platform():
+    """Honor JAX_PLATFORMS for embedded hosts: the axon sitecustomize
+    forces its own platform list at interpreter start, so the env var is
+    otherwise ignored; an embedding C host has no other way to pick the
+    backend."""
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            pass
+
+
+_maybe_force_platform()
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+def config_create():
+    import flexflow_tpu as ff
+
+    return ff.FFConfig()
+
+
+def config_parse_args(args: Sequence[str]):
+    """Reference flexflow_config_parse_args: build an FFConfig from the
+    reference's command-line flag set."""
+    import flexflow_tpu as ff
+
+    return ff.FFConfig.from_args(list(args))
+
+
+def config_set(cfg, key: str, value: str) -> int:
+    """Set one config field from its string form, coerced to the field's
+    current type. A field currently holding ``None`` (Optional) infers
+    the type from the literal instead: true/false -> bool,
+    none/null/"" -> None, numeric -> int/float, else str — so e.g.
+    setting ``search_profile`` to "false" stores False, not the truthy
+    string. Returns 0 on success, -1 on unknown key/bad value."""
+    if not hasattr(cfg, key):
+        return -1
+    cur = getattr(cfg, key)
+    try:
+        if isinstance(cur, bool):
+            val = value.lower() in ("1", "true", "yes", "on")
+        elif isinstance(cur, int):
+            val = int(value)
+        elif isinstance(cur, float):
+            val = float(value)
+        elif isinstance(cur, str):
+            val = value
+        elif cur is None:
+            low = value.lower()
+            if low in ("true", "false", "yes", "no", "on", "off"):
+                val = low in ("true", "yes", "on")
+            elif low in ("", "none", "null"):
+                val = None
+            else:
+                try:
+                    val = int(value)
+                except ValueError:
+                    try:
+                        val = float(value)
+                    except ValueError:
+                        val = value
+        else:
+            return -1
+        setattr(cfg, key, val)
+        return 0
+    except ValueError:
+        return -1
+
+
+def config_get(cfg, key: str) -> str:
+    return "" if not hasattr(cfg, key) else str(getattr(cfg, key))
+
+
+# ---------------------------------------------------------------------------
+# model build + weights (reference flexflow_model_create + file loader)
+# ---------------------------------------------------------------------------
+
+_FAMILIES = {}
+
+
+def _families() -> Dict[str, tuple]:
+    if not _FAMILIES:
+        from flexflow_tpu.models.falcon import FalconConfig, \
+            create_falcon_model
+        from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+        from flexflow_tpu.models.mpt import MPTConfig, create_mpt_model
+        from flexflow_tpu.models.opt import OPTConfig, create_opt_model
+        from flexflow_tpu.models.starcoder import (STARCODERConfig,
+                                                   create_starcoder_model)
+
+        _FAMILIES.update({
+            "llama": (LLAMAConfig, create_llama_model),
+            "opt": (OPTConfig, create_opt_model),
+            "falcon": (FalconConfig, create_falcon_model),
+            "mpt": (MPTConfig, create_mpt_model),
+            "starcoder": (STARCODERConfig, create_starcoder_model),
+        })
+    return _FAMILIES
+
+
+class _ServingHost:
+    """One compiled serving model + its RequestManager."""
+
+    def __init__(self, model):
+        from flexflow_tpu.serve.request_manager import RequestManager
+
+        self.model = model
+        self.rm = RequestManager()
+        self.results: Dict[int, List[int]] = {}
+
+
+def llm_create(cfg, spec_json: str) -> _ServingHost:
+    """Build + compile a serving model from a JSON spec:
+
+    ``{"family": "llama", "model_config": {<family Config kwargs>},
+       "mode": "inc" | "spec" | "tree",
+       "weights_npz": "<path>" (optional — default is seeded init)}``
+
+    The reference counterpart chains flexflow_model_create, the per-op
+    builder calls, FileDataLoader weight load and init_operators_inference
+    (flexflow_c.cc); here one call owns build->compile->weight load.
+    """
+    import flexflow_tpu as ff
+    from flexflow_tpu.ffconst import CompMode, InferenceMode
+
+    spec = json.loads(spec_json)
+    family = spec.get("family", "llama")
+    if family not in _families():
+        raise ValueError(f"unknown model family {family!r}; "
+                         f"have {sorted(_families())}")
+    cfg_cls, create = _families()[family]
+    mcfg = cfg_cls(**spec.get("model_config", {}))
+    mode = {"inc": InferenceMode.INC_DECODING_MODE,
+            "spec": InferenceMode.BEAM_SEARCH_MODE,
+            "tree": InferenceMode.TREE_VERIFY_MODE}[spec.get("mode", "inc")]
+    model = ff.FFModel(cfg)
+    create(model, mcfg, mode)
+    model.compile(comp_mode=CompMode.COMP_MODE_INFERENCE)
+    weights = spec.get("weights_npz")
+    if weights:
+        from flexflow_tpu.training.checkpoint import load_weights_npz
+
+        load_weights_npz(weights, model)
+    return _ServingHost(model)
+
+
+# ---------------------------------------------------------------------------
+# requests + generation (reference RequestManager + flexflow_model_generate)
+# ---------------------------------------------------------------------------
+
+def register_request(host: _ServingHost, tokens: Sequence[int],
+                     max_new_tokens: int) -> int:
+    return host.rm.register_new_request(
+        [int(t) for t in tokens], max_new_tokens=int(max_new_tokens))
+
+
+def generate(host: _ServingHost) -> int:
+    """Run incremental decoding for every pending request (reference
+    flexflow_model_generate, flexflow_c.cc:1584). Returns the number of
+    finished requests; outputs are fetched per-request afterwards."""
+    results = host.rm.generate_incr_decoding(host.model)
+    for r in results:
+        host.results[r.guid] = [int(t) for t in r.output_tokens]
+    return len(results)
+
+
+def get_output(host: _ServingHost, request_id: int) -> List[int]:
+    return host.results.get(int(request_id), [])

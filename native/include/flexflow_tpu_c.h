@@ -170,6 +170,45 @@ int ffgb_output(void *handle, const int *ids, int n);
 int ffgb_save(void *handle, const char *path);
 int ffgb_serialize(void *handle, char *out, int cap);
 
+/* ---------------- serving ABI (libflexflow_tpu_serve.so) -----------
+ * Config create/parse, model build, weight load, request registration
+ * and generate — the reference's full-surface C API role
+ * (src/c/flexflow_c.cc; flexflow_model_generate :1584), letting a
+ * non-Python host run serving end-to-end (the reference's C++ mains,
+ * inference/incr_decoding/incr_decoding.cc:118). Implemented in
+ * native/src/serve_c.cpp over an embedded CPython runtime (the role
+ * Legion plays in the reference); link -lflexflow_tpu_serve AND the
+ * matching -lpython3.x. Handles are opaque; release with ffsv_release.
+ * Not thread-safe (like the reference C API). */
+
+/* Init the embedded runtime; repo_root = dir containing flexflow_tpu
+ * (NULL if already importable). 0 on success. */
+int ffsv_init(const char *repo_root);
+const char *ffsv_last_error(void);
+void ffsv_release(void *handle);
+
+void *ffsv_config_create(void);
+/* Reference flexflow_config_parse_args (same flag set as FFConfig.from_args). */
+void *ffsv_config_parse_args(int argc, const char **argv);
+int ffsv_config_set(void *cfg, const char *key, const char *value);
+char *ffsv_config_get(void *cfg, const char *key);   /* caller frees */
+
+/* Build + compile a serving model. spec_json:
+ * {"family":"llama|opt|falcon|mpt|starcoder",
+ *  "model_config":{...family Config kwargs...},
+ *  "mode":"inc|spec|tree", "weights_npz":"path" (optional)} */
+void *ffsv_llm_create(void *cfg, const char *spec_json);
+
+/* Register a tokenized prompt; returns the request guid, or -1. */
+long ffsv_register_request(void *llm, const int32_t *tokens, int n_tokens,
+                           int max_new_tokens);
+/* Decode every pending request to completion (reference
+ * flexflow_model_generate). Returns finished count, or -1. */
+int ffsv_generate(void *llm);
+/* Fetch a finished request's output tokens; returns the full count
+ * (recall with more room if it exceeds cap), or -1. */
+int ffsv_get_output(void *llm, long guid, int32_t *out, int cap);
+
 #ifdef __cplusplus
 }
 #endif

@@ -703,17 +703,18 @@ def test_fork_joins_detects_inception_branches():
     assert sorted(sum(branches, [])) == list(range(f + 1, j))
 
 
-def test_nonsequence_split_beats_dp_and_sequence_only_search():
-    """The VERDICT gate: on an Inception-style PCG the searched strategy
-    places branches on disjoint device subsets (OpStrategy.branch tags),
-    and its simulated cost beats BOTH the naive DP baseline and the
-    sequence-only search (the same search with the nonsequence pass
-    disabled)."""
+def test_nonsequence_split_beats_dp_under_concurrent_costing():
+    """Search-space parity with the reference: under the reference's
+    Legion semantics (branch_concurrency=True — disjoint device subsets
+    really run different tasks concurrently,
+    find_optimal_nonsequence_graph_time graph.h:181-196) the search
+    places Inception branches on disjoint data-axis slices and beats
+    both DP and the sequence-only search analytically."""
     model = _inception_model()
     pcg = PCG.from_model(model)
     axes = {"data": 4, "model": 1}
     machine = MachineModel.from_name("v5e", 4)
-    cm = CostModel(machine, axes, training=True)
+    cm = CostModel(machine, axes, training=True, branch_concurrency=True)
     search = UnitySearch(pcg, cm, axes, enable_substitutions=False)
     # sequence-only: the same DP+beam and dp-baseline path, with the
     # nonsequence pass disabled by stubbing fork_joins
@@ -729,6 +730,24 @@ def test_nonsequence_split_beats_dp_and_sequence_only_search():
     assert len({bi for (bi, _) in branch_tags}) == 4
     assert s_full.cost < s_seq.cost, (s_full.cost, s_seq.cost)
     assert s_full.cost < dp.cost, (s_full.cost, dp.cost)
+
+
+def test_nonsequence_split_rejected_under_executable_costing():
+    """The round-5 honest default: XLA SPMD lowers device-dependent
+    control flow by running EVERY branch on every device (measured: a
+    shard_map lax.switch over N conv branches costs >= N x one branch),
+    so with branch_concurrency=False the search must keep DP for a
+    compute-dense fork-join — matching the measured wall-clock A/B
+    (test_branchy_wallclock below, PARITY.md round-5 record)."""
+    model = _inception_model()
+    pcg = PCG.from_model(model)
+    axes = {"data": 4, "model": 1}
+    machine = MachineModel.from_name("v5e", 4)
+    cm = CostModel(machine, axes, training=True)   # default: executable
+    search = UnitySearch(pcg, cm, axes, enable_substitutions=False)
+    s = search.optimize_graph(pcg)
+    assert not any(st.branch for st in s.ops.values()), \
+        "executable costing must not choose a branch split it cannot win"
 
 
 def test_conv_candidates_cover_soap_dims():
