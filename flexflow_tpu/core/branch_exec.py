@@ -87,10 +87,24 @@ def build_branch_plan(model) -> Optional[BranchPlan]:
             == CompMode.COMP_MODE_INFERENCE):
         return None
 
+    # RNG-consuming ops (dropout, train-MHA dropout, sampling) cannot run
+    # inside the region: every data shard of a branch would fold the SAME
+    # per-layer key, duplicating masks across batch shards and diverging
+    # from the sequential path's full-batch draw
+    rng_ops = {OpType.DROPOUT, OpType.MULTIHEAD_ATTENTION,
+               OpType.SAMPLING}
+
     tags = {}
     for ly in model.layers:
         st = strategy.ops.get(ly.name)
         if st is not None and st.branch is not None:
+            if (getattr(st, "branch_alloc", None) is not None
+                    or getattr(st, "branch_axis", "data") != "data"):
+                # unequal or non-data-axis splits have no equal-slice
+                # shard_map plan (per-device shapes would differ) —
+                # execute sequentially; branch_parallel_apply(allocs=...)
+                # covers the unequal form for explicit use
+                return None
             tags[ly.name] = st.branch
 
     if not tags:
@@ -148,7 +162,8 @@ def build_branch_plan(model) -> Optional[BranchPlan]:
         names = {ly.name for c in chains for ly in c}
         if names & claimed or names & stateful:
             continue
-        if any(len(ly.outputs) != 1 for c in chains for ly in c):
+        if any(len(ly.outputs) != 1 or ly.op_type in rng_ops
+               for c in chains for ly in c):
             continue
         # no branch tensor may escape the region: every consumer of a
         # chain output must be a later layer of the SAME chain or the

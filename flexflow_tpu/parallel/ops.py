@@ -131,7 +131,8 @@ class ReplicateOrAllReduce(_ParallelOp):
         return [_constrain(x, ctx.mesh, [None] * x.ndim)]
 
 
-def branch_parallel_apply(mesh, axis, branch_fns, out_channels, x):
+def branch_parallel_apply(mesh, axis, branch_fns, out_channels, x,
+                          allocs=None):
     """Execute independent branch subgraphs on DISJOINT device slices of a
     mesh axis — the runtime form of a searched nonsequence split
     (reference NonsequenceSplit, include/flexflow/graph.h:156;
@@ -143,11 +144,26 @@ def branch_parallel_apply(mesh, axis, branch_fns, out_channels, x):
     are zero-padded on the channel dim to a common width, all-gathered,
     and returned as per-branch arrays with their true channel counts (the
     caller concats/consumes them). Branches must agree on every dim
-    except dim 1 (channels). ``x`` is consumed replicated."""
+    except dim 1 (channels). ``x`` is consumed replicated.
+
+    ``allocs`` (optional): per-branch device counts summing to the axis
+    size — the reference's UNEQUAL vertical(i)/horizontal(i) resource
+    partitions (graph.cc:220-244); default one device per branch.
+    NOTE (PARITY r5): under XLA SPMD the switch lowers to every device
+    executing every branch, so this form is numerics-correct but cannot
+    beat DP inside one program — it exists for search-space execution
+    parity, not as the fast path."""
+    import numpy as _np
+
     import jax.numpy as jnp
 
-    nb = mesh.shape[axis]
-    assert len(branch_fns) == nb == len(out_channels)
+    d = mesh.shape[axis]
+    nb = len(branch_fns)
+    if allocs is None:
+        assert nb == d == len(out_channels)
+        allocs = [1] * nb
+    assert sum(allocs) == d and len(allocs) == nb == len(out_channels)
+    starts = _np.cumsum([0] + list(allocs))[:-1]
     cmax = max(out_channels)
 
     def padded(f, c):
@@ -161,13 +177,15 @@ def branch_parallel_apply(mesh, axis, branch_fns, out_channels, x):
     fns = [padded(f, c) for f, c in zip(branch_fns, out_channels)]
 
     def local(xl):
-        i = jax.lax.axis_index(axis)
-        y = jax.lax.switch(i, fns, xl)           # [B, Cmax, ...]
-        return jax.lax.all_gather(y, axis)       # [nb, B, Cmax, ...]
+        j = jax.lax.axis_index(axis)
+        # branch owning device j: number of starts <= j, minus one
+        bi = jnp.sum(jnp.asarray(starts) <= j) - 1
+        y = jax.lax.switch(bi, fns, xl)          # [B, Cmax, ...]
+        return jax.lax.all_gather(y, axis)       # [d, B, Cmax, ...]
 
     out = jax.shard_map(local, mesh=mesh, in_specs=P(), out_specs=P(),
                         check_vma=False)(x)
-    return [out[i, :, :c] for i, c in enumerate(out_channels)]
+    return [out[int(starts[i]), :, :c] for i, c in enumerate(out_channels)]
 
 
 def branch_data_parallel_apply(mesh, axis, branch_fns, branch_params,

@@ -86,12 +86,18 @@ class CostModel:
 
     def _axes_for(self, st: OpStrategy) -> Dict[str, int]:
         """Effective axis degrees for an op: a branch-pinned op (nonsequence
-        split) sees only its slice of the data axis."""
+        split) sees only its slice of the branch axis — an equal 1/nb
+        slice, or its ``branch_alloc`` device count for unequal
+        (vertical(i)/horizontal(i), reference graph.cc:220-244) splits."""
         if st.branch is None:
             return self.axes
         _, nb = st.branch
         axes = dict(self.axes)
-        axes["data"] = max(1, axes.get("data", 1) // nb)
+        ax = st.branch_axis
+        if st.branch_alloc is not None:
+            axes[ax] = max(1, st.branch_alloc[0])
+        else:
+            axes[ax] = max(1, axes.get(ax, 1) // nb)
         return axes
 
     # ---- per-node compute ------------------------------------------------

@@ -172,57 +172,89 @@ class UnitySearch:
             return strategy
         return self._try_nonsequence_splits(pcg, strategy)
 
+    def _branch_trial(self, pcg: PCG, base: Strategy, branches,
+                      allocs, axis: str) -> Strategy:
+        """Build one nonsequence-split trial: branch ``bi`` re-optimized
+        under ``axis`` scaled to ``allocs[bi]`` devices and tagged."""
+        import dataclasses as _dc
+
+        nb = len(branches)
+        total = self.axes.get(axis, 1)
+        trial = Strategy(ops=dict(base.ops))
+        saved_cm, saved_axes, saved_pcg = self.cm, self.axes, self.pcg
+        try:
+            for bi, comp in enumerate(branches):
+                scaled = dict(saved_axes)
+                scaled[axis] = allocs[bi]
+                self.cm = CostModel(
+                    saved_cm.machine, scaled, training=saved_cm.training,
+                    overlap=saved_cm.overlap,
+                    branch_concurrency=saved_cm.branch_concurrency)
+                self.axes = scaled
+                self.pcg = pcg           # _candidate_delta reads producers
+                chosen = self._optimize_segment(
+                    [pcg.nodes[i] for i in comp], boundary={})
+                equal = all(a == total // nb for a in allocs)
+                for i, st in chosen.items():
+                    trial.ops[pcg.nodes[i].name] = _dc.replace(
+                        st, branch=(bi, nb), branch_axis=axis,
+                        branch_alloc=(None if equal
+                                      else (allocs[bi], total)))
+        finally:
+            self.cm, self.axes, self.pcg = saved_cm, saved_axes, saved_pcg
+        return trial
+
     def _try_nonsequence_splits(self, pcg: PCG,
                                 strategy: Strategy) -> Strategy:
-        """Vertical nonsequence splits (reference NonsequenceSplit,
-        graph.h:156; find_optimal_nonsequence_graph_time graph.h:181-196):
-        for every fork-join region whose branches are independent, try
-        pinning each branch to a DISJOINT slice of the data axis. Branch
-        ops are re-optimized under the scaled axes (data/nb) and tagged
-        with ``OpStrategy.branch``; the overlap simulator then runs the
-        branch timelines concurrently. The split is kept only when the
-        simulated step time improves — Inception/DLRM-style branchy PCGs
-        are where it wins; straight-line transformers never trigger it."""
-        d = self.axes.get("data", 1)
-        if d < 2:
-            return strategy
+        """Nonsequence splits (reference NonsequenceSplit, graph.h:156;
+        find_optimal_nonsequence_graph_time graph.h:181-196): for every
+        fork-join region whose branches are independent, try pinning each
+        branch to a DISJOINT slice of a mesh axis. Candidate forms:
+
+        * equal slices of the data axis (nb-way, any branch count);
+        * equal slices of the MODEL or EXPERT axis (branch pinning is not
+          data-only — a branch can own a tensor/expert-parallel group);
+        * for 2-branch regions, UNEQUAL i-vs-(n-i) device partitions of
+          the data axis — the reference's VERTICAL(i) (node units) and
+          HORIZONTAL(i) (within-node units) params, graph.cc:220-244;
+          slice-aligned counts are the vertical form, others horizontal.
+
+        Branch ops are re-optimized under the scaled axes and tagged with
+        ``OpStrategy.branch`` (+``branch_alloc``/``branch_axis``); the
+        overlap simulator runs branch timelines concurrently (under
+        ``branch_concurrency=True`` — the executable default serializes
+        them, see CostModel). A split is kept only when the simulated
+        step time improves."""
         fork_joins = pcg.fork_joins()
         if not fork_joins:
             return strategy
-        import dataclasses as _dc
-
         best = strategy
         m = self.cm.simulate(pcg, best)
         best_score = m.total + self.mem_lambda * m.memory
         for (f, j, branches) in fork_joins:
             nb = len(branches)
-            if nb < 2 or d % nb != 0:
+            if nb < 2:
                 continue
-            scaled = dict(self.axes)
-            scaled["data"] = d // nb
-            trial = Strategy(ops=dict(best.ops))
-            saved_cm, saved_axes, saved_pcg = self.cm, self.axes, self.pcg
-            self.cm = CostModel(
-                saved_cm.machine, scaled, training=saved_cm.training,
-                overlap=saved_cm.overlap,
-                branch_concurrency=saved_cm.branch_concurrency)
-            self.axes = scaled
-            self.pcg = pcg               # _candidate_delta reads producers
-            try:
-                for bi, comp in enumerate(branches):
-                    chosen = self._optimize_segment(
-                        [pcg.nodes[i] for i in comp], boundary={})
-                    for i, st in chosen.items():
-                        trial.ops[pcg.nodes[i].name] = _dc.replace(
-                            st, branch=(bi, nb))
-            finally:
-                self.cm, self.axes, self.pcg = saved_cm, saved_axes, saved_pcg
-            mt = self.cm.simulate(pcg, trial)
-            score = mt.total + self.mem_lambda * mt.memory
-            if score < best_score:
-                trial.cost = mt.total
-                trial.peak_memory = mt.memory
-                best, best_score = trial, score
+            trials = []
+            for axis in ("data", "model", "expert"):
+                deg = self.axes.get(axis, 1)
+                if deg >= 2 and deg % nb == 0:
+                    trials.append(([deg // nb] * nb, axis))
+            d = self.axes.get("data", 1)
+            if nb == 2 and d >= 2:
+                # unequal vertical/horizontal params (i, d - i)
+                for i in range(1, d):
+                    if i != d - i:       # equal case covered above
+                        trials.append(([i, d - i], "data"))
+            for allocs, axis in trials:
+                trial = self._branch_trial(pcg, best, branches, allocs,
+                                           axis)
+                mt = self.cm.simulate(pcg, trial)
+                score = mt.total + self.mem_lambda * mt.memory
+                if score < best_score:
+                    trial.cost = mt.total
+                    trial.peak_memory = mt.memory
+                    best, best_score = trial, score
         return best
 
     def _dp_baseline(self, pcg: PCG) -> Optional[Strategy]:

@@ -75,6 +75,11 @@ def searched_vs_dp_wallclock(build_model: Callable[[], object], xs, ys,
         if variant == "dp":
             strat = data_parallel_model_strategy(probe, chip=chip,
                                                  num_devices=n)
+            if strat is None:
+                raise ValueError(
+                    f"no canonical DP strategy for this model over {n} "
+                    "devices (batch dim not divisible) — the A/B has no "
+                    "meaningful DP baseline")
 
             def build_dp():
                 m = build_model()

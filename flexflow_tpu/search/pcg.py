@@ -412,5 +412,8 @@ class PCG:
                     comps.setdefault(find(m), []).append(m)
                 if len(comps) >= 2:
                     out.append((f, j, sorted(comps.values())))
-                break                     # nearest join only
+                    break             # nearest REAL join only: a contained
+                    # single-component region (a chain hanging off the
+                    # fork) must not end the scan before the true
+                    # post-dominator is reached (r5 regression)
         return out

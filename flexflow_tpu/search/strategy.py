@@ -60,11 +60,21 @@ class OpStrategy:
     # ways — the reference's NonsequenceSplit device-subset assignment
     # (include/flexflow/graph.h:156). None = the op spans all devices.
     branch: Optional[Tuple[int, int]] = None
+    # Unequal-resource split (the reference's VERTICAL(i)/HORIZONTAL(i)
+    # params, graph.cc:220-244 — both are i-vs-rest device partitions,
+    # vertical in node units, horizontal in per-node device units):
+    # (devices_for_this_branch, total_devices). None = equal slices.
+    branch_alloc: Optional[Tuple[int, int]] = None
+    # Mesh axis the branch slices live on; the search can also pin
+    # branches over the model/expert axes, not just data.
+    branch_axis: str = "data"
 
     def key(self) -> str:
         return json.dumps([self.input_specs, self.output_spec,
                            sorted(self.weight_specs.items()),
-                           self.partial_axes, self.branch], default=list)
+                           self.partial_axes, self.branch,
+                           self.branch_alloc, self.branch_axis],
+                          default=list)
 
 
 @dataclasses.dataclass
@@ -88,6 +98,10 @@ class Strategy:
                 "partial": list(s.partial_axes),
                 "name": s.name,
                 **({"branch": list(s.branch)} if s.branch else {}),
+                **({"branch_alloc": list(s.branch_alloc)}
+                   if s.branch_alloc else {}),
+                **({"branch_axis": s.branch_axis}
+                   if s.branch_axis != "data" else {}),
             }
 
         return json.dumps({"cost": self.cost, "peak_memory": self.peak_memory,
@@ -108,6 +122,9 @@ class Strategy:
                 partial_axes=tuple(d["partial"]),
                 name=d.get("name", ""),
                 branch=tuple(d["branch"]) if d.get("branch") else None,
+                branch_alloc=(tuple(d["branch_alloc"])
+                              if d.get("branch_alloc") else None),
+                branch_axis=d.get("branch_axis", "data"),
             )
 
         return cls(ops={k: dec(v) for k, v in raw["ops"].items()},

