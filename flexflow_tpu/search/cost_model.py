@@ -147,14 +147,27 @@ class CostModel:
                 hb = shard_bytes(tuple(halo_shape), node.dtype_bytes,
                                  tuple(spec_wo), axes)
                 m.comm_time += 2.0 * self.machine.ppermute_time(hb)
-        # gradient sync: weights replicated over "data" ⇒ allreduce of grads
+        # gradient sync: a weight's grads must be allreduced over every
+        # mesh axis the weight is REPLICATED over while the op's
+        # activations are sharded over it — the data axis (classic DP
+        # grad sync) and any activation-sharding axis the weight spec
+        # does not carry (attr-dim dense, spatially-sharded convs: each
+        # model shard computes a partial dL/dW over its activation
+        # slice, so XLA inserts a full-weight allreduce over that axis)
         if self.training and node.weight_shapes:
+            act_axes = {a for spec in ((tuple(st.output_spec),)
+                                       + tuple(st.input_specs))
+                        for a in spec if a is not None}
             data_deg = axes.get("data", 1)
-            if data_deg > 1:
-                for w, shape in node.weight_shapes.items():
-                    wspec = st.weight_specs.get(w, (None,) * len(shape))
+            for w, shape in node.weight_shapes.items():
+                wspec = st.weight_specs.get(w, (None,) * len(shape))
+                waxes = {a for a in wspec if a is not None}
+                group = data_deg if data_deg > 1 else 1
+                for a in act_axes - waxes - {"data"}:
+                    group *= axes.get(a, 1)
+                if group > 1:
                     wb = shard_bytes(shape, node.dtype_bytes, wspec, axes)
-                    m.sync_time += self.machine.all_reduce_time(wb, data_deg)
+                    m.sync_time += self.machine.all_reduce_time(wb, group)
         m.memory = self.node_memory(node, st)
         return m
 

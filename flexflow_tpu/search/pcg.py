@@ -158,14 +158,36 @@ class PCGNode:
                        OpType.SPEC_INC_MULTIHEAD_SELF_ATTENTION,
                        OpType.TREE_INC_MULTIHEAD_SELF_ATTENTION):
                 add_attention_candidates(self, cands, data, model)
-            elif t == OpType.EMBEDDING and "kernel" in self.weight_shapes:
+            elif t == OpType.EMBEDDING and self.weight_shapes:
                 add_embedding_candidates(self, cands, data, model)
             elif t == OpType.CONV2D and "kernel" in self.weight_shapes:
                 add_conv_candidates(self, cands, data, model)
             elif t == OpType.EXPERTS:
                 add_expert_candidates(self, cands, data, model,
                                       axis_degrees)
-        return cands
+        # validity filter: a sharded dim must DIVIDE its axis degree —
+        # the runtime's constrain()/weight_sharding fall back to
+        # replicated otherwise (parallel/spec.py), so a non-dividing
+        # candidate would be costed with a phantom speedup the executed
+        # program never delivers
+        def _divides(spec, shape):
+            return all(ax is None or (dim % axis_degrees.get(ax, 1) == 0)
+                       for dim, ax in zip(shape, tuple(spec)))
+
+        def _valid(c: OpStrategy) -> bool:
+            if self.output_shapes and not _divides(c.output_spec,
+                                                   self.output_shapes[0]):
+                return False
+            for spec, shape in zip(c.input_specs, self.input_shapes):
+                if not _divides(spec, shape):
+                    return False
+            for w, shape in self.weight_shapes.items():
+                if w in c.weight_specs and not _divides(c.weight_specs[w],
+                                                        shape):
+                    return False
+            return True
+
+        return [c for c in cands if _valid(c)] or cands[:1]
 
 
 def _batch(nd: int, axis) -> Spec:
@@ -204,6 +226,26 @@ def add_linear_candidates(node: PCGNode, cands: List[OpStrategy],
                           **({"bias": (None,)} if has_bias else {})},
             partial_axes=(model,),
             name=f"tp-row{'+dp' if dax else ''}"))
+        # attribute-dim parallelism — the A of SOAP for dense layers
+        # (reference enable_attribute_parallel, config.h:148-150): an
+        # INTERIOR activation dim (DLRM/XDL feature fields, sequence)
+        # sharded over 'model'; the gemm stays shard-local with weights
+        # replicated, so only edge resharding is paid.
+        if out_nd >= 3 and node.input_shapes \
+                and len(node.input_shapes[0]) >= 3:
+            at_out = list(_batch(out_nd, dax))
+            at_out[1] = model
+            at_ins = []
+            for s in node.input_shapes:
+                spec = list(_batch(len(s), dax))
+                if len(s) >= 3:
+                    spec[1] = model
+                at_ins.append(tuple(spec))
+            cands.append(OpStrategy(
+                input_specs=tuple(at_ins), output_spec=tuple(at_out),
+                weight_specs={"kernel": (None, None),
+                              **({"bias": (None,)} if has_bias else {})},
+                name=f"attr-dim{'+dp' if dax else ''}"))
 
 
 def add_attention_candidates(node: PCGNode, cands: List[OpStrategy],
@@ -234,7 +276,11 @@ def add_attention_candidates(node: PCGNode, cands: List[OpStrategy],
 def add_embedding_candidates(node: PCGNode, cands: List[OpStrategy],
                              data: Optional[str], model: str):
     """Hidden-dim-parallel embedding table (shard out_dim; gather stays
-    local). Vocab-parallel (partial output) also offered."""
+    local). Vocab-parallel (partial output) also offered — reference
+    src/ops/embedding.cc "weight sharded on vocab or replica"."""
+    # the op's weight leaf is "weight" (ops/embedding.py); older graphs
+    # may carry "kernel"
+    wname = "weight" if "weight" in node.weight_shapes else "kernel"
     out_nd = len(node.output_shapes[0])
     for dax in ({None, data} if data else {None}):
         ins = tuple(_batch(len(s), dax) for s in node.input_shapes)
@@ -242,11 +288,11 @@ def add_embedding_candidates(node: PCGNode, cands: List[OpStrategy],
         out[-1] = model
         cands.append(OpStrategy(
             input_specs=ins, output_spec=tuple(out),
-            weight_specs={"kernel": (None, model)},
+            weight_specs={wname: (None, model)},
             name=f"tp-hidden{'+dp' if dax else ''}"))
         cands.append(OpStrategy(
             input_specs=ins, output_spec=_batch(out_nd, dax),
-            weight_specs={"kernel": (model, None)},
+            weight_specs={wname: (model, None)},
             partial_axes=(model,),
             name=f"tp-vocab{'+dp' if dax else ''}"))
 

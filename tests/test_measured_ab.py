@@ -173,3 +173,54 @@ def test_data_parallel_model_strategy_covers_all_layers():
     weighted = [ly.name for ly in m.layers if ly.weights]
     assert all(n in dp.ops for n in weighted)
     assert all(st.branch is None for st in dp.ops.values())
+
+
+def _dlrm(tables=4, vocab=50000):
+    """DLRM/XDL-style PCG: big embedding tables + bottom/top MLPs
+    (reference examples/cpp/DLRM; src/ops/embedding.cc vocab/replica
+    sharding). DP must replicate and allreduce every table's grads; the
+    searched strategy shards the tables over 'model'."""
+    cfg = ff.FFConfig(batch_size=32, data_parallelism_degree=2,
+                      tensor_parallelism_degree=4, tpu_chip="v5e", seed=0)
+    m = ff.FFModel(cfg)
+    dense_in = m.create_tensor([32, 16], ff.DataType.DT_FLOAT)
+    parts = [m.dense(m.dense(dense_in, 64, ff.ActiMode.AC_MODE_RELU), 64)]
+    for _ in range(tables):
+        ids = m.create_tensor([32, 2], ff.DataType.DT_INT32)
+        parts.append(m.flat(m.embedding(ids, vocab, 64)))
+    x = m.concat(parts, axis=1)
+    m.softmax(m.dense(m.dense(x, 64, ff.ActiMode.AC_MODE_RELU), 2))
+    return m
+
+
+def test_dlrm_searched_shards_embeddings_and_beats_dp():
+    """VERDICT r4 item 5: on a DLRM-style PCG the searched strategy
+    shards the embedding tables over 'model' and beats DP — analytically
+    AND by wall clock (the tables' grad allreduce dominates DP)."""
+    from flexflow_tpu.search import (CostModel, MachineModel, PCG,
+                                     UnitySearch)
+
+    m = _dlrm()
+    pcg = PCG.from_model(m)
+    axes = {"data": 2, "model": 4}
+    cm = CostModel(MachineModel.from_name("v5e", 8), axes, training=True)
+    search = UnitySearch(pcg, cm, axes, enable_substitutions=False)
+    s = search.optimize_graph(pcg)
+    dp = search._dp_baseline(pcg)
+    emb = {n: st for n, st in s.ops.items() if n.startswith("embedding")}
+    assert emb and all(
+        "model" in tuple(st.weight_specs.get("weight", ()))
+        for st in emb.values()), {n: st.weight_specs for n, st in emb.items()}
+    assert s.cost < dp.cost
+
+    # wall-clock A/B through the runtime
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(32, 16).astype(np.float32)] + \
+        [rng.randint(0, 50000, size=(32, 2)).astype(np.int32)
+         for _ in range(4)]
+    ys = rng.randint(0, 2, size=(32, 1)).astype(np.int32)
+    res = searched_vs_dp_wallclock(_dlrm, xs, ys, chip="v5e",
+                                   num_devices=8, steps=2, reps=2,
+                                   variants=("searched", "dp"))
+    print(format_ab("dlrm", res))
+    assert res["searched"]["wallclock"] < res["dp"]["wallclock"], res
