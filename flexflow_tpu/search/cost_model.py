@@ -163,7 +163,10 @@ class CostModel:
                 wspec = st.weight_specs.get(w, (None,) * len(shape))
                 waxes = {a for a in wspec if a is not None}
                 group = data_deg if data_deg > 1 else 1
-                for a in act_axes - waxes - {"data"}:
+                # partial_axes are psum'd on the FORWARD output, so the
+                # incoming grads are replicated over them — a tp-row
+                # bias's grads need only the data-axis sync
+                for a in act_axes - waxes - {"data"} - set(st.partial_axes):
                     group *= axes.get(a, 1)
                 if group > 1:
                     wb = shard_bytes(shape, node.dtype_bytes, wspec, axes)

@@ -187,7 +187,10 @@ class PCGNode:
                     return False
             return True
 
-        return [c for c in cands if _valid(c)] or cands[:1]
+        # cands[0] is the all-None replicate strategy, which _divides
+        # trivially, so the filtered list is never empty — the invariant
+        # "everything returned divides its axes" holds unconditionally
+        return [c for c in cands if _valid(c)]
 
 
 def _batch(nd: int, axis) -> Spec:

@@ -47,8 +47,12 @@ _JSON_OP_TYPES = {
     "OP_EMBEDDING": OpType.EMBEDDING,
     "OP_MULTIHEAD_ATTENTION": OpType.MULTIHEAD_ATTENTION,
 }
-_PARALLEL_JSON_OPS = {"OP_PARTITION", "OP_COMBINE", "OP_REPLICATE",
-                      "OP_REDUCE", "OP_PIPELINE", "OP_FUSED_PARALLEL"}
+_PARALLEL_OP_TYPES = {
+    "OP_PARTITION": OpType.REPARTITION,
+    "OP_COMBINE": OpType.COMBINE,
+    "OP_REPLICATE": OpType.REPLICATE,
+    "OP_REDUCE": OpType.REDUCTION,
+}
 
 
 @dataclasses.dataclass
@@ -86,6 +90,35 @@ def _attr_present(v) -> bool:
     return True
 
 
+_ELEMENTWISE_DST = {OpType.RELU, OpType.SIGMOID, OpType.TANH,
+                    OpType.EW_ADD, OpType.EW_MUL, OpType.SOFTMAX,
+                    OpType.DROPOUT}
+
+
+def _infer_output_shapes(node) -> Optional[List[Tuple[int, ...]]]:
+    """Output shapes of a materialized dst node from its wired inputs;
+    None = keep the proto's shapes (unknown op form)."""
+    ins = node.input_shapes
+    if not ins:
+        return None
+    t = node.op_type
+    if t in _ELEMENTWISE_DST:
+        if len(ins) >= 2 and len(ins[0]) == len(ins[1]):
+            # numpy-style broadcast: per-dim max (dims of 1 broadcast)
+            return [tuple(max(a, b) for a, b in zip(ins[0], ins[1]))]
+        return [tuple(ins[0])]
+    if t == OpType.CONCAT:
+        ax = node.attrs.get("axis", 1) % max(len(ins[0]), 1)
+        if any(len(s) != len(ins[0]) for s in ins):
+            return None
+        out = list(ins[0])
+        out[ax] = sum(s[ax] for s in ins)
+        return [tuple(out)]
+    if t == OpType.LINEAR and "out_dim" in node.attrs:
+        return [tuple(ins[0][:-1]) + (node.attrs["out_dim"],)]
+    return None
+
+
 class GraphXfer:
     """Match a Rule's src pattern in a PCG and produce the rewritten graph."""
 
@@ -115,7 +148,7 @@ class GraphXfer:
                 # inputs must line up with already-bound pattern producers
                 ok = True
                 for slot, (src_op, _ts) in enumerate(px.inputs):
-                    if src_op == -1:
+                    if src_op < 0:
                         continue           # external input: anything
                     bound = binding.get(src_op)
                     if bound is None or (slot >= len(node.in_edges)
@@ -138,21 +171,25 @@ class GraphXfer:
 
         matched = set(match.values())
         src_nodes = [pcg.nodes[match[pi]] for pi in range(len(self.rule.src))]
-        # External pattern tensors, identified by ts id (reference TensorX):
-        # producing graph node (None = a graph input) and tensor shape.
-        ext_producer: Dict[int, Optional[int]] = {}
-        ext_shape: Dict[int, Tuple[int, ...]] = {}
+        # External pattern tensors (reference TensorX), identified by the
+        # (negative opId, tsId) PAIR — the reference's JSON rules number
+        # distinct externals -1, -2, ... each with tsId 0, so keying by
+        # ts id alone would collide them. Value: producing graph node
+        # (None = a graph input) and tensor shape.
+        ext_producer: Dict[Tuple[int, int], Optional[int]] = {}
+        ext_shape: Dict[Tuple[int, int], Tuple[int, ...]] = {}
         for pi, px in enumerate(self.rule.src):
             g = pcg.nodes[match[pi]]
             for slot, (src_op, ts) in enumerate(px.inputs):
-                if src_op != -1:
+                if src_op >= 0:
                     continue
+                key = (src_op, ts)
                 prod = g.in_edges[slot] if slot < len(g.in_edges) else None
-                if ts in ext_producer and ext_producer[ts] != prod:
+                if key in ext_producer and ext_producer[key] != prod:
                     return None          # inconsistent external binding
-                ext_producer[ts] = prod
+                ext_producer[key] = prod
                 if slot < len(g.input_shapes):
-                    ext_shape[ts] = g.input_shapes[slot]
+                    ext_shape[key] = g.input_shapes[slot]
 
         new_nodes: List[PCGNode] = []
         remap: Dict[int, int] = {}
@@ -176,8 +213,23 @@ class GraphXfer:
                 if dop == di:
                     proto = pcg.nodes[match[sop]]
                     break
+            if proto is None and dx.op_type is not None:
+                # inherit semantic attrs (axis, out_dim, ...) from a
+                # matched src op of the SAME type — JSON rules carry dims
+                # in the reference's reversed order, so the matched
+                # node's attrs are the trustworthy source. The inheritance
+                # must be UNIQUE: with two same-type src ops (e.g. a TASO
+                # linear-merge rule) picking either would cost the
+                # rewritten node on the wrong out_dim/weights, and a dst
+                # type absent from src has no faithful proto at all —
+                # refuse such rewrites rather than fire them with phantom
+                # attrs/weight shapes.
+                same = [s for s in src_nodes if s.op_type == dx.op_type]
+                if len(same) != 1:
+                    return None
+                proto = same[0]
             if proto is None:
-                proto = src_nodes[min(di, len(src_nodes) - 1)]
+                return None
             n2 = copy.deepcopy(proto)
             n2.idx = len(new_nodes)
             n2.name = f"{proto.name}__xfer{di}"
@@ -207,14 +259,20 @@ class GraphXfer:
             n2.out_edges = []
             dst_graph_idx[di] = n2.idx
             new_nodes.append(n2)
-        # Wire dst inputs (externals by ts id; graph inputs carry no edge)
+        # Wire dst inputs (externals by (opId, tsId); graph inputs carry
+        # no edge), then infer each dst node's output shapes from its
+        # wired inputs — a materialized node (e.g. a new CONCAT) must not
+        # keep its proto's shapes or the rewritten graph would be costed
+        # on phantom sizes. dst ops are listed producers-first in both
+        # the builtin and reference rule formats.
         for di, dx in enumerate(self.rule.dst):
             n2 = new_nodes[dst_graph_idx[di]]
             for slot, (src_op, ts) in enumerate(dx.inputs):
-                if src_op == -1:
-                    if ts in ext_shape:
-                        n2.input_shapes.append(ext_shape[ts])
-                    prod = ext_producer.get(ts)
+                if src_op < 0:
+                    key = (src_op, ts)
+                    if key in ext_shape:
+                        n2.input_shapes.append(ext_shape[key])
+                    prod = ext_producer.get(key)
                     if prod is None:
                         continue             # a graph input: no edge
                     src_graph = remap.get(prod)
@@ -229,6 +287,9 @@ class GraphXfer:
                         n2.input_shapes.append(src_out[ts])
                 n2.in_edges.append(src_graph)
                 new_nodes[src_graph].out_edges.append(n2.idx)
+            inferred = _infer_output_shapes(n2)
+            if inferred is not None:
+                n2.output_shapes = inferred
         # Re-route surviving nodes' inputs: unmatched producers keep their
         # remapped index; matched producers must be mapped outputs → dst op.
         replace: Dict[int, int] = {}
@@ -309,29 +370,42 @@ def builtin_rules() -> List[Rule]:
     return rules
 
 
-def load_rules_json(path: str) -> List[Rule]:
-    """Load reference-format substitution rules (graph_subst_3_v2.json).
-    Rules using only implemented op types load as Rule objects; rules built
-    from parallel ops (OP_PARTITION/...) are recognized and skipped — their
-    semantics live in the sharding candidate space here."""
+def load_rules_json(path: str, include_parallel: bool = False) -> List[Rule]:
+    """Load reference-format substitution rules (graph_subst_3_v2.json;
+    schema per src/runtime/substitution_loader.cc).
+
+    Algebraic rules (the TASO fusion/reassociation core) load always.
+    With ``include_parallel=True`` the parallel-op rules (OP_PARTITION /
+    OP_COMBINE / OP_REPLICATE / OP_REDUCE chains — the reference's
+    mechanism for exploring parallelization as graph rewrites) ALSO load,
+    mapped onto this framework's parallel op types; they can only match
+    graphs that contain explicit parallel-op nodes (the builder's
+    repartition/combine/replicate/reduction verbs) — spec-based PCGs
+    never do, since GSPMD sharding subsumes their role (see module
+    docstring). Default off to keep the joint search's match loop tight.
+
+    ``PM_*`` parameters are NOT copied onto dst attrs: the reference
+    encodes dims in its reversed Legion order, so dst nodes inherit
+    semantic attrs (axis, out_dim, ...) from the matched same-op-type
+    src node instead (GraphXfer.apply proto selection)."""
     with open(path) as f:
         raw = json.load(f)
+    known = dict(_JSON_OP_TYPES)
+    if include_parallel:
+        known.update(_PARALLEL_OP_TYPES)
     out: List[Rule] = []
     for r in raw.get("rule", []):
         ops = {o["type"] for o in r.get("srcOp", []) + r.get("dstOp", [])}
-        if ops & _PARALLEL_JSON_OPS:
-            continue                       # parallelization rule → sharding space
-        if not ops <= set(_JSON_OP_TYPES):
+        if not ops <= set(known):
             continue                       # unimplemented op type
 
         def conv(olist) -> List[OpX]:
             res = []
             for o in olist:
                 res.append(OpX(
-                    op_type=_JSON_OP_TYPES[o["type"]],
-                    inputs=[(t["opId"], t["tsId"]) for t in o.get("input", [])],
-                    params={p["key"]: p["value"]
-                            for p in o.get("para", [])}))
+                    op_type=known[o["type"]],
+                    inputs=[(t["opId"], t["tsId"])
+                            for t in o.get("input", [])]))
             return res
 
         out.append(Rule(
