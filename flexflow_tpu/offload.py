@@ -59,27 +59,74 @@ def offload_model_weights(model, min_bytes: int = 1 << 20) -> int:
     def on_host(arr):
         return getattr(arr.sharding, "memory_kind", None) == "pinned_host"
 
+    def page_out(container, wname, w):
+        """Move one eligible weight to host IN PLACE; returns the
+        device sharding snapshot to stream it back to, or None when
+        ineligible / already paged. One shared eligibility+idempotency
+        rule for the per-layer and stage-stacked paths."""
+        nonlocal moved
+        if wname not in _OFFLOAD_NAMES:
+            return None
+        if is_quantized(w):
+            if w.nbytes < min_bytes or on_host(w.q):
+                return None
+            dev_sh = {"q": w.q.sharding, "scale": w.scale.sharding}
+            w.q = _to_host(w.q)
+            w.scale = _to_host(w.scale)
+        else:
+            if getattr(w, "nbytes", 0) < min_bytes or w.ndim < 2 \
+                    or on_host(w):
+                return None
+            dev_sh = w.sharding
+            container[wname] = _to_host(w)
+        moved += w.nbytes
+        return dev_sh
+
+    from flexflow_tpu.serve.pipeline_plan import PP_PARAMS_KEY
+
     for lname, ws in (model.params or {}).items():
-        for wname, w in ws.items():
-            if wname not in _OFFLOAD_NAMES:
-                continue
-            if is_quantized(w):
-                if w.nbytes < min_bytes or on_host(w.q):
-                    continue
-                dev_sh = {"q": w.q.sharding, "scale": w.scale.sharding}
-                w.q = _to_host(w.q)
-                w.scale = _to_host(w.scale)
-                moved += w.nbytes
-            else:
-                if getattr(w, "nbytes", 0) < min_bytes or w.ndim < 2 \
-                        or on_host(w):
-                    continue
-                dev_sh = w.sharding
-                ws[wname] = _to_host(w)
-                moved += w.nbytes
-            offloaded.setdefault(lname, {})[wname] = dev_sh
+        if lname == PP_PARAMS_KEY:
+            # stage-stacked pipeline weights ({pos: {wname: leaf}}): page
+            # the stacked leaves; the pp segment streams each block's
+            # slice back per use (stage-local paging — PP x offload,
+            # reference config.h:144-146). The fetch there is a
+            # memory-space transfer (it happens inside shard_map), so
+            # record membership only, not shardings.
+            for pos, per_w in ws.items():
+                for wname, w in list(per_w.items()):
+                    if page_out(per_w, wname, w) is not None:
+                        offloaded.setdefault(PP_PARAMS_KEY, {}).setdefault(
+                            str(pos), {})[wname] = True
+            continue
+        for wname, w in list(ws.items()):
+            dev_sh = page_out(ws, wname, w)
+            if dev_sh is not None:
+                offloaded.setdefault(lname, {})[wname] = dev_sh
     model._offloaded = offloaded
     return moved
+
+
+def fetch_block_params(lp: Dict[str, Any],
+                       off_names) -> Dict[str, Any]:
+    """Stream a pipeline block's offloaded weights back to device memory
+    from INSIDE the shard_map'd pp segment (a memory-space transfer —
+    jax.memory.Space.Device — since shardings are per-device there).
+    XLA schedules the host->HBM stream against the block's compute, the
+    stage-local form of the reference's per-use paging
+    (linear_kernels.cu:30-40)."""
+    if not off_names:
+        return lp
+    from jax.memory import Space
+
+    def to_dev(w):
+        if isinstance(w, QuantizedWeight):
+            return QuantizedWeight(
+                w.qtype, jax.device_put(w.q, Space.Device),
+                jax.device_put(w.scale, Space.Device), w.rows, w.dtype)
+        return jax.device_put(w, Space.Device)
+
+    return {wn: (to_dev(w) if wn in off_names else w)
+            for wn, w in lp.items()}
 
 
 def fetch_layer_params(lp: Optional[Dict[str, Any]],

@@ -50,6 +50,12 @@ class PCGNode:
     attrs: Dict = dataclasses.field(default_factory=dict)
     in_edges: List[int] = dataclasses.field(default_factory=list)   # node idxs
     out_edges: List[int] = dataclasses.field(default_factory=list)
+    # per-INPUT-SLOT producer node idx (None = a graph input) and tensor
+    # id — in_edges dedupes and drops graph-input slots, so slot-aligned
+    # pattern matching (substitution.py) must read these instead
+    input_srcs: List[Optional[int]] = dataclasses.field(default_factory=list)
+    input_tids: List[int] = dataclasses.field(default_factory=list)
+    output_tids: List[int] = dataclasses.field(default_factory=list)
     # Original layer names this node stands for. A substitution that fuses
     # k ops into one node unions their covers, so the searched strategy can
     # be expanded back onto the model's real layers after the joint search.
@@ -383,11 +389,14 @@ class PCG:
             )
             for t in layer.inputs:
                 src = tensor_producer.get(t.tensor_id)
+                node.input_srcs.append(src)
+                node.input_tids.append(t.tensor_id)
                 if src is not None and src not in node.in_edges:
                     node.in_edges.append(src)
                     nodes[src].out_edges.append(i)
             for t in layer.outputs:
                 tensor_producer[t.tensor_id] = i
+                node.output_tids.append(t.tensor_id)
             nodes.append(node)
         return cls(nodes)
 

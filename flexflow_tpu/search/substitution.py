@@ -119,6 +119,30 @@ def _infer_output_shapes(node) -> Optional[List[Tuple[int, ...]]]:
     return None
 
 
+import itertools as _it
+
+# synthetic tensor ids for unmapped dst outputs: strictly decreasing so
+# no two apply() calls ever mint the same id
+_SYNTH_TIDS = _it.count(-1_000_000, -1)
+
+
+def _slot_srcs(node) -> List[Optional[int]]:
+    """Per-slot producer node idxs. PCGNode.in_edges dedupes repeated
+    producers and drops graph-input slots, so slot-aligned matching must
+    use input_srcs; hand-built test nodes without slot info fall back to
+    the positional in_edges view."""
+    if len(node.input_srcs) == len(node.input_shapes):
+        return node.input_srcs
+    return list(node.in_edges) + [None] * (len(node.input_shapes)
+                                           - len(node.in_edges))
+
+
+def _slot_tids(node) -> List[Optional[int]]:
+    if len(node.input_tids) == len(node.input_shapes):
+        return node.input_tids
+    return [None] * len(node.input_shapes)
+
+
 class GraphXfer:
     """Match a Rule's src pattern in a PCG and produce the rewritten graph."""
 
@@ -133,7 +157,7 @@ class GraphXfer:
         pat = self.rule.src
 
         def backtrack(pi: int, binding: Dict[int, int],
-                      tensor_bind: Dict[Tuple[int, int], int]):
+                      ext_bind: Dict[Tuple[int, int], int]):
             if pi == len(pat):
                 matches.append(dict(binding))
                 return
@@ -145,21 +169,38 @@ class GraphXfer:
                     continue
                 if any(_attr_present(node.attrs.get(k)) for k in px.forbid):
                     continue
-                # inputs must line up with already-bound pattern producers
+                srcs = _slot_srcs(node)
+                tids = _slot_tids(node)
+                if px.inputs and len(px.inputs) != len(srcs):
+                    continue               # arity must match the pattern
+                # inputs must line up with already-bound pattern
+                # producers; a REUSED external (same negative opId in two
+                # slots — reference same-TensorX semantics) must bind the
+                # same concrete tensor everywhere
                 ok = True
-                for slot, (src_op, _ts) in enumerate(px.inputs):
+                added: List[Tuple[int, int]] = []
+                for slot, (src_op, ts) in enumerate(px.inputs):
                     if src_op < 0:
-                        continue           # external input: anything
+                        key = (src_op, ts)
+                        tid = tids[slot]
+                        if key in ext_bind:
+                            if tid is None or ext_bind[key] != tid:
+                                ok = False
+                                break
+                        elif tid is not None:
+                            ext_bind[key] = tid
+                            added.append(key)
+                        continue
                     bound = binding.get(src_op)
-                    if bound is None or (slot >= len(node.in_edges)
-                                         or node.in_edges[slot] != bound):
+                    if bound is None or srcs[slot] != bound:
                         ok = False
                         break
-                if not ok:
-                    continue
-                binding[pi] = node.idx
-                backtrack(pi + 1, binding, tensor_bind)
-                del binding[pi]
+                if ok:
+                    binding[pi] = node.idx
+                    backtrack(pi + 1, binding, ext_bind)
+                    del binding[pi]
+                for key in added:
+                    ext_bind.pop(key, None)
 
         backtrack(0, {}, {})
         return matches
@@ -178,16 +219,24 @@ class GraphXfer:
         # (None = a graph input) and tensor shape.
         ext_producer: Dict[Tuple[int, int], Optional[int]] = {}
         ext_shape: Dict[Tuple[int, int], Tuple[int, ...]] = {}
+        ext_tid: Dict[Tuple[int, int], Optional[int]] = {}
         for pi, px in enumerate(self.rule.src):
             g = pcg.nodes[match[pi]]
+            srcs = _slot_srcs(g)
+            tids = _slot_tids(g)
             for slot, (src_op, ts) in enumerate(px.inputs):
                 if src_op >= 0:
                     continue
                 key = (src_op, ts)
-                prod = g.in_edges[slot] if slot < len(g.in_edges) else None
-                if key in ext_producer and ext_producer[key] != prod:
+                prod = srcs[slot] if slot < len(srcs) else None
+                tid = tids[slot] if slot < len(tids) else None
+                # a reused external must bind ONE concrete tensor: tensor
+                # identity, not just producer (two distinct graph inputs
+                # both have producer None)
+                if key in ext_tid and (tid is None or ext_tid[key] != tid):
                     return None          # inconsistent external binding
                 ext_producer[key] = prod
+                ext_tid[key] = tid
                 if slot < len(g.input_shapes):
                     ext_shape[key] = g.input_shapes[slot]
 
@@ -253,10 +302,25 @@ class GraphXfer:
                 n2.covers = list(proto.covered_names)
             n2.attrs = dict(n2.attrs)
             n2.attrs.update(dx.params)
-            # input shapes follow the dst wiring, resolved below
+            # input shapes/slots follow the dst wiring, resolved below
             n2.input_shapes = []
             n2.in_edges = []
             n2.out_edges = []
+            n2.input_srcs = []
+            n2.input_tids = []
+            # output tensor ids: a mapped output INHERITS the replaced
+            # src output's tid, so surviving consumers' per-slot tids
+            # stay valid in the rewritten graph; unmapped outputs get
+            # fresh synthetic ids from a global countdown (per-apply
+            # indices would collide across successive rewrites of the
+            # same graph and falsely unify distinct tensors)
+            n2.output_tids = [next(_SYNTH_TIDS)
+                              for _ in range(max(len(n2.output_shapes), 1))]
+            for (dop, dts, sop, sts) in self.rule.mapped_outputs:
+                if dop == di and dts < len(n2.output_tids):
+                    src_t = pcg.nodes[match[sop]].output_tids
+                    if sts < len(src_t):
+                        n2.output_tids[dts] = src_t[sts]
             dst_graph_idx[di] = n2.idx
             new_nodes.append(n2)
         # Wire dst inputs (externals by (opId, tsId); graph inputs carry
@@ -272,8 +336,10 @@ class GraphXfer:
                     key = (src_op, ts)
                     if key in ext_shape:
                         n2.input_shapes.append(ext_shape[key])
+                    n2.input_tids.append(ext_tid.get(key))
                     prod = ext_producer.get(key)
                     if prod is None:
+                        n2.input_srcs.append(None)
                         continue             # a graph input: no edge
                     src_graph = remap.get(prod)
                     if src_graph is None:
@@ -285,11 +351,31 @@ class GraphXfer:
                     src_out = new_nodes[src_graph].output_shapes
                     if ts < len(src_out):
                         n2.input_shapes.append(src_out[ts])
-                n2.in_edges.append(src_graph)
-                new_nodes[src_graph].out_edges.append(n2.idx)
+                    src_t = new_nodes[src_graph].output_tids
+                    n2.input_tids.append(src_t[ts] if ts < len(src_t)
+                                         else None)
+                n2.input_srcs.append(src_graph)
+                if src_graph not in n2.in_edges:
+                    n2.in_edges.append(src_graph)
+                    new_nodes[src_graph].out_edges.append(n2.idx)
             inferred = _infer_output_shapes(n2)
             if inferred is not None:
                 n2.output_shapes = inferred
+        # multi-dst provenance completeness: every matched src layer must
+        # appear in SOME dst node's covers, or expand_strategy would emit
+        # no OpStrategy for its real layer and compile would fall back to
+        # a sharding the winning cost estimate never modeled
+        if not single_dst:
+            covered = {nm for d in dst_graph_idx.values()
+                       for nm in new_nodes[d].covered_names}
+            missing = [nm for s in src_nodes for nm in s.covered_names
+                       if nm not in covered]
+            if missing:
+                primary = (dst_graph_idx[self.rule.mapped_outputs[0][0]]
+                           if self.rule.mapped_outputs
+                           else next(iter(dst_graph_idx.values())))
+                pn = new_nodes[primary]
+                pn.covers = list(pn.covered_names) + missing
         # Re-route surviving nodes' inputs: unmatched producers keep their
         # remapped index; matched producers must be mapped outputs → dst op.
         replace: Dict[int, int] = {}
@@ -308,6 +394,17 @@ class GraphXfer:
                 else:
                     return None            # consumed a non-mapped matched output
             n2.in_edges = edges
+            slots = []
+            for old in n2.input_srcs:
+                if old is None:
+                    slots.append(None)
+                elif old in remap:
+                    slots.append(remap[old])
+                elif old in replace:
+                    slots.append(replace[old])
+                else:
+                    return None
+            n2.input_srcs = slots
         # rebuild out_edges
         for n2 in new_nodes:
             n2.out_edges = []
@@ -335,6 +432,8 @@ class GraphXfer:
             n2.idx = pos[n2.idx]
             n2.in_edges = [pos[e] for e in n2.in_edges]
             n2.out_edges = [pos[e] for e in n2.out_edges]
+            n2.input_srcs = [pos[e] if e is not None else None
+                             for e in n2.input_srcs]
         return PCG(sorted_nodes)
 
 

@@ -187,15 +187,17 @@ class LLM:
             # 4/8-bit weight-only compression (reference --4bit/--8bit-
             # quantization flags): done post-load so scales see real weights
             self.ffmodel.quantize_weights(config.quantization_type)
+        # stage-shard the transformer blocks over the "pipe" axis now that
+        # weights are loaded (reference inference_manager.cc:91-132
+        # places layer blocks per stage at model-compile time). Runs
+        # BEFORE offload so paging applies to the stage-stacked leaves
+        # (PP x offload composes, reference config.h:144-146)
+        self.ffmodel.finalize_pipeline()
         if config.cpu_offload:
             # page (possibly compressed) weights to pinned host memory
             # (reference -offload); quantize-then-offload streams 4-8x
             # fewer bytes per step
             self.ffmodel.offload_weights()
-        # stage-shard the transformer blocks over the "pipe" axis now that
-        # weights are loaded (reference inference_manager.cc:91-132
-        # places layer blocks per stage at model-compile time)
-        self.ffmodel.finalize_pipeline()
         self.ffmodel.finalize_gemm_fusion()
 
         self.rm = RequestManager()

@@ -220,10 +220,10 @@ def finalize_pipeline(model) -> None:
     if plan is None or PP_PARAMS_KEY in model.params:
         return
     if getattr(model, "_offloaded", None):
-        raise NotImplementedError(
-            "pipeline_parallelism_degree > 1 does not compose with "
-            "cpu_offload yet: stage-sharded weights are already 1/P per "
-            "device; drop one of the two")
+        raise RuntimeError(
+            "finalize_pipeline must run BEFORE offload_weights so paging "
+            "applies to the stage-stacked leaves (LLM.compile orders "
+            "them; re-run offload_weights after this call)")
     from flexflow_tpu.quant import QuantizedWeight, is_quantized
 
     mesh = model.mesh
@@ -327,14 +327,21 @@ def _apply_block(model, plan, ctx, lp_by_pos, k_l, v_l, x):
     values = {plan.block_entry_tid: x}
     ctx.kv_override = (k_l, v_l)
     ctx.kv_written = None
+    pp_off = (getattr(model, "_offloaded", None) or {}).get(PP_PARAMS_KEY,
+                                                            {})
     for pos, layer in enumerate(plan.template):
         from flexflow_tpu.ops.base import get_op_impl
 
         impl = get_op_impl(layer.op_type)
         ins = [values[t.tensor_id] for t in layer.inputs]
         ctx.layer_name = layer.name
-        outs = impl.forward(layer.attrs, lp_by_pos.get(str(pos), {}), ins,
-                            ctx)
+        lp = lp_by_pos.get(str(pos), {})
+        off_names = pp_off.get(str(pos))
+        if off_names:
+            from flexflow_tpu.offload import fetch_block_params
+
+            lp = fetch_block_params(lp, off_names)
+        outs = impl.forward(layer.attrs, lp, ins, ctx)
         for t, v in zip(layer.outputs, outs):
             values[t.tensor_id] = v
     new_k, new_v = ctx.kv_written

@@ -1,0 +1,59 @@
+"""Worker for the two-process multi-host test (test_distributed.py).
+
+Joins the jax distributed runtime through flexflow_tpu.distributed
+.initialize (the mpirun-rank equivalent of the reference's multinode
+launch, SURVEY §2.4), builds a global mesh spanning both processes, and
+drives ONE cross-process reduction through it. Run as:
+
+    python tests/_mp_worker.py <coordinator addr> <process_id>
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flexflow_tpu.distributed import (host_local_batch, initialize,
+                                      process_info)
+
+
+def main():
+    coordinator, pid = sys.argv[1], int(sys.argv[2])
+    ok = initialize(coordinator_address=coordinator, num_processes=2,
+                    process_id=pid)
+    assert ok, "initialize() did not enter multi-process mode"
+
+    import numpy as np
+
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    me, nproc, local, glob = process_info()
+    assert me == pid and nproc == 2, (me, nproc)
+    assert glob == 2 * local, (glob, local)
+    assert host_local_batch(8) == 4
+
+    # global mesh over BOTH processes' devices; each process contributes
+    # its local shard, the jitted reduction psums across the process
+    # boundary (XLA collectives over the distributed runtime — the
+    # NCCL/MPI-backend equivalent)
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+    local_rows = np.full((local, 4), float(pid + 1), np.float32)
+    arr = jax.make_array_from_process_local_data(sharding, local_rows)
+    out = jax.jit(lambda a: jnp.sum(a, axis=0),
+                  out_shardings=NamedSharding(mesh, P()))(arr)
+    got = np.asarray(out.addressable_shards[0].data)
+    want = np.full((4,), float(local * (1 + 2)), np.float32)
+    assert np.allclose(got, want), (got, want)
+    print(f"MP_OK pid={pid} devices={glob} sum={got[0]}")
+
+
+if __name__ == "__main__":
+    main()
