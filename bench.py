@@ -78,7 +78,13 @@ else:                     # LLaMA-2-7B geometry, int8 weights
     NEW_TOKENS = 160      # reference CI generates 128; longer runs also
                           # amortize the remote-tunnel dispatch latency
                           # that is NOT part of the serving system itself
-DRAFT_LAYERS = 2
+def _arg_int(flag, default):
+    if flag in sys.argv:
+        return int(sys.argv[sys.argv.index(flag) + 1])
+    return default
+
+
+DRAFT_LAYERS = _arg_int("--draft-layers", 2)
 EPS = 0.01          # residual damping for layers >= DRAFT_LAYERS
 # Draft depth 7: the B=1 tree pads its verify width to the sublane (8),
 # so depths 4-7 share the SAME verify cost — only cheap draft-model
@@ -86,7 +92,7 @@ EPS = 0.01          # residual damping for layers >= DRAFT_LAYERS
 # paying out at the deeper chain. Within the reference's envelope
 # (MAX_BEAM_DEPTH=8, batch_config.h:126). Verify-consistent decode keeps
 # the token-match gate at 8/8 at this depth (width 8 either way).
-SPEC_DEPTH = 7
+SPEC_DEPTH = _arg_int("--spec-depth", 7)
 NUM_REQUESTS = 8
 PROMPT_LEN = 32
 MAX_SEQ = 256
@@ -161,19 +167,10 @@ def build_models():
 
     llm = build(vcfg, InferenceMode.TREE_VERIFY_MODE)
     # Damp deep-layer residual writes so the truncated draft stays
-    # correlated with the full model's greedy output.
-    from flexflow_tpu.quant import dequantize_array, is_quantized, \
-        quantize_array
-
-    def scaled(leaf, factor):
-        if is_quantized(leaf):
-            return quantize_array(dequantize_array(leaf) * factor, leaf.qtype)
-        return leaf * factor
-
-    for i in range(DRAFT_LAYERS, LAYERS):
-        for lname, w in ((f"layers.{i}.self_attn", "wo"),
-                         (f"layers.{i}.mlp.down_proj", "kernel")):
-            llm.params[lname][w] = scaled(llm.params[lname][w], EPS)
+    # correlated with the full model's greedy output (one shared rescale
+    # helper with the acceptance sweep, so both always touch the same
+    # weight set).
+    rescale_deep_layers(llm, EPS)
     draft_layer_counts = ([DRAFT_LAYERS, DRAFT_LAYERS + 1] if MULTI
                           else [DRAFT_LAYERS])
     ssms = []
@@ -186,6 +183,25 @@ def build_models():
                     ssm.params[lname][w] = llm.params[lname][w]
         ssms.append(ssm)
     return (llm, ssms) if MULTI else (llm, ssms[0])
+
+
+def rescale_deep_layers(llm, factor: float):
+    """Re-scale the verifier's damped deep-layer residual writes IN
+    PLACE (the draft shares only the shallow layers, so this moves the
+    draft-verifier divergence without touching the draft or the compiled
+    programs — params are call arguments)."""
+    from flexflow_tpu.quant import dequantize_array, is_quantized, \
+        quantize_array
+
+    def scaled(leaf, f):
+        if is_quantized(leaf):
+            return quantize_array(dequantize_array(leaf) * f, leaf.qtype)
+        return leaf * f
+
+    for i in range(DRAFT_LAYERS, LAYERS):
+        for lname, w in ((f"layers.{i}.self_attn", "wo"),
+                         (f"layers.{i}.mlp.down_proj", "kernel")):
+            llm.params[lname][w] = scaled(llm.params[lname][w], factor)
 
 
 def run_requests(fn, prompts, new_tokens):
@@ -304,10 +320,16 @@ def _bf16_companion_line():
 
     try:
         # hard cap: a wedged child must not starve the int8 headline run
+        # forward explicit tuning flags so the companion line measures the
+        # same configuration the caller asked for
+        extra = []
+        for flag in ("--draft-layers", "--spec-depth"):
+            if flag in sys.argv:
+                extra += [flag, str(_arg_int(flag, 0))]
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--small",
-             "--no-mfu"],
-            capture_output=True, text=True, timeout=900)
+             "--no-mfu", *extra],
+            capture_output=True, text=True, timeout=1500)
         lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
         if r.returncode == 0 and lines:
             d = json.loads(lines[-1])
@@ -318,6 +340,8 @@ def _bf16_companion_line():
                 "bf16_incr_tokens_per_s": d.get("incr_tokens_per_s"),
                 "bf16_spec_matches_incr_first30":
                     d.get("spec_matches_incr_first30"),
+                "bf16_tokens_per_round": d.get("tokens_per_round"),
+                "bf16_acceptance_sweep": d.get("acceptance_sweep"),
             }
         return {"bf16_line": f"error rc={r.returncode}: "
                              f"{r.stderr.strip()[-200:]}"}
@@ -443,6 +467,35 @@ def main():
         return sum(incr_by_in[tuple(r.input_tokens)][:prefix]
                    == r.output_tokens[:prefix] for r in spec_res)
 
+    # --- acceptance-realism sweep (VERDICT r4 weak-5/item 7): the
+    # headline's tokens/round comes from ONE damping point (EPS); vary
+    # the draft-verifier divergence by re-scaling the verifier's deep
+    # layers and report tokens/round + speedup per regime, up to the
+    # fully-undamped worst case (eps=1.0 — a truncation draft of a
+    # genuinely random-init verifier). The draft shares only shallow
+    # layers, so only the VERIFIER moves; spec stays exact vs itself,
+    # and the incr baseline's throughput is weight-value-independent.
+    sweep = []
+    if SMALL and not SMOKE and "--no-sweep" not in sys.argv:
+        cur = EPS
+        for eps in (0.05, 0.2, 1.0):
+            rescale_deep_layers(llm, eps / cur)
+            cur = eps
+            meter2 = AcceptanceMeter().install()
+            try:
+                tps_e, _res_e = with_retry(
+                    lambda: run_requests(
+                        lambda rm: rm.generate_spec_infer(
+                            llm, ssms, spec_depth=SPEC_DEPTH),
+                        prompts, NEW_TOKENS), f"sweep eps={eps}")
+            finally:
+                meter2._restore()
+            st = meter2.stats()
+            sweep.append({
+                "eps": eps,
+                "tokens_per_round": st.get("tokens_per_round"),
+                "speedup_vs_incr": round(tps_e / incr_tps, 3)})
+
     # train MFU on the same chip (full harness: bench_train.py)
     pallas_active = ffk.use_pallas(llm.config)
     del llm, ssm, ssms, eng, ifm
@@ -488,6 +541,7 @@ def main():
             f"{m_full}/{len(spec_res)}",
         # measured acceptance — the rate the headline was achieved at
         **meter.stats(),
+        **({"acceptance_sweep": sweep} if sweep else {}),
         # trace-time dispatch counts: how many attention ops COMPILED onto
         # each path (fused loops trace once however many steps execute)
         "attention_fast_path_traces": ffk.fast_path_count,

@@ -84,6 +84,14 @@ def offload_model_weights(model, min_bytes: int = 1 << 20) -> int:
 
     from flexflow_tpu.serve.pipeline_plan import PP_PARAMS_KEY
 
+    if (getattr(model, "_pp_plan", None) is not None
+            and PP_PARAMS_KEY not in (model.params or {})):
+        # a pending pipeline plan must stack BEFORE paging (stage-local
+        # paging applies to the stacked leaves); handle the ordering here
+        # so any call order works instead of dead-ending in
+        # finalize_pipeline's guard
+        model.finalize_pipeline()
+
     for lname, ws in (model.params or {}).items():
         if lname == PP_PARAMS_KEY:
             # stage-stacked pipeline weights ({pos: {wname: leaf}}): page
