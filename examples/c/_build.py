@@ -26,3 +26,35 @@ def compile_and_emit(c_basename: str, tmpdir: str) -> str:
         p for p in (lib_dir, env.get("LD_LIBRARY_PATH")) if p)
     subprocess.run([exe, ir], check=True, env=env)
     return ir
+
+
+def compile_and_run_serve(c_basename: str, ok_marker: str) -> str:
+    """Build libflexflow_tpu_serve, compile a C serving main against it
+    (plus libpython), run it with the repo root, and assert the marker.
+    Shared by run_incr_decoding.py / run_spec_infer.py."""
+    import sysconfig
+
+    lib_dir = os.path.join(_ROOT, "native", "build")
+    subprocess.run(["make", "-C", os.path.join(_ROOT, "native")],
+                   check=True, capture_output=True)
+    pylib = "python" + sysconfig.get_config_var("LDVERSION")
+    pylibdir = sysconfig.get_config_var("LIBDIR")
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        exe = os.path.join(td, os.path.splitext(c_basename)[0])
+        subprocess.run([os.environ.get("CC", "cc"),
+                        os.path.join(_HERE, c_basename),
+                        "-L" + lib_dir, "-lflexflow_tpu_serve",
+                        "-L" + pylibdir, "-l" + pylib, "-o", exe],
+                       check=True)
+        env = dict(os.environ)
+        env["LD_LIBRARY_PATH"] = os.pathsep.join(
+            p for p in (lib_dir, pylibdir, env.get("LD_LIBRARY_PATH"))
+            if p)
+        # the embedded interpreter honors JAX_PLATFORMS via capi_host's
+        # platform override (the axon sitecustomize otherwise pins it)
+        out = subprocess.run([exe, _ROOT], check=True, env=env,
+                             capture_output=True, text=True)
+        assert ok_marker in out.stdout, out.stdout
+        return out.stdout.strip()

@@ -8,38 +8,17 @@ embedded runtime.
 """
 
 import os as _os
-import subprocess as _sp
 import sys as _sys
-import sysconfig as _sc
-import tempfile as _tf
 
 _HERE = _os.path.dirname(_os.path.abspath(__file__))
-_ROOT = _os.path.abspath(_os.path.join(_HERE, *[_os.pardir] * 2))
-_sys.path.insert(0, _ROOT)
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_HERE, *[_os.pardir] * 2)))
+_sys.path.insert(0, _HERE)
+
+from _build import compile_and_run_serve
 
 
 def top_level_task():
-    lib_dir = _os.path.join(_ROOT, "native", "build")
-    _sp.run(["make", "-C", _os.path.join(_ROOT, "native")], check=True,
-            capture_output=True)
-    pylib = "python" + _sc.get_config_var("LDVERSION")
-    pylibdir = _sc.get_config_var("LIBDIR")
-    with _tf.TemporaryDirectory() as td:
-        exe = _os.path.join(td, "incr_decoding")
-        _sp.run([_os.environ.get("CC", "cc"),
-                 _os.path.join(_HERE, "incr_decoding.c"),
-                 "-L" + lib_dir, "-lflexflow_tpu_serve",
-                 "-L" + pylibdir, "-l" + pylib, "-o", exe], check=True)
-        env = dict(_os.environ)
-        env["LD_LIBRARY_PATH"] = _os.pathsep.join(
-            p for p in (lib_dir, pylibdir, env.get("LD_LIBRARY_PATH"))
-            if p)
-        # the embedded interpreter honors JAX_PLATFORMS via capi_host's
-        # platform override (the axon sitecustomize otherwise pins it)
-        out = _sp.run([exe, _ROOT], check=True, env=env,
-                      capture_output=True, text=True)
-        print(out.stdout.strip())
-        assert "C incr_decoding OK" in out.stdout, out.stdout
+    print(compile_and_run_serve("incr_decoding.c", "C incr_decoding OK"))
 
 
 if __name__ == "__main__":

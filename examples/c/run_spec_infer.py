@@ -1,0 +1,21 @@
+"""Driver for the C speculative-decoding main: build the serve library,
+compile examples/c/spec_infer.c against it, run the binary — tree
+speculation driven end-to-end from C (reference
+inference/spec_infer/spec_infer.cc through flexflow_c.cc)."""
+
+import os as _os
+import sys as _sys
+
+_HERE = _os.path.dirname(_os.path.abspath(__file__))
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_HERE, *[_os.pardir] * 2)))
+_sys.path.insert(0, _HERE)
+
+from _build import compile_and_run_serve
+
+
+def top_level_task():
+    print(compile_and_run_serve("spec_infer.c", "C spec_infer OK"))
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,62 @@
+/* Speculative decoding driven end-to-end from C through the ffsv_* ABI
+ * — the role of the reference's C++ spec_infer main
+ * (reference inference/spec_infer/spec_infer.cc:201: build LLM in tree
+ * -verify mode + SSMs in beam-search mode, register requests,
+ * generate). The draft here is a 2-layer truncation of the verifier —
+ * the same seeded per-layer-name init makes the shallow weights match
+ * automatically, so acceptance is non-trivial even without real
+ * checkpoints (weights load via the spec's "weights_npz" in
+ * production).
+ *
+ *   cc spec_infer.c -L../../native/build -lflexflow_tpu_serve \
+ *      -lpython3.12 -o spec_infer
+ *   ./spec_infer /path/to/repo
+ */
+#include <stdio.h>
+
+#include "../../native/include/flexflow_tpu_c.h"
+
+#define MODEL_JSON(layers)                                              \
+  "{\"family\": \"llama\", \"model_config\": {"                         \
+  "\"vocab_size\": 128, \"hidden_size\": 64, "                          \
+  "\"intermediate_size\": 128, \"num_hidden_layers\": " #layers ", "    \
+  "\"num_attention_heads\": 4, \"num_key_value_heads\": 2, "            \
+  "\"max_position_embeddings\": 64}}"
+
+int main(int argc, char **argv) {
+  const char *repo_root = argc > 1 ? argv[1] : NULL;
+  if (ffsv_init(repo_root) != 0) {
+    fprintf(stderr, "init failed: %s\n", ffsv_last_error());
+    return 1;
+  }
+  void *cfg = ffsv_config_create();
+  ffsv_config_set(cfg, "max_requests_per_batch", "2");
+  ffsv_config_set(cfg, "max_sequence_length", "64");
+  ffsv_config_set(cfg, "max_tokens_per_batch", "16");
+  ffsv_config_set(cfg, "kv_cache_dtype", "float32");
+
+  void *pair = ffsv_spec_create(cfg, MODEL_JSON(4), MODEL_JSON(2));
+  if (!pair) {
+    fprintf(stderr, "spec create failed: %s\n", ffsv_last_error());
+    return 1;
+  }
+
+  int32_t prompt[] = {5, 9, 23, 7};
+  long g = ffsv_register_request(pair, prompt, 4, 6);
+  if (g < 0 || ffsv_generate_spec(pair, 3) != 1) {
+    fprintf(stderr, "spec generate failed: %s\n", ffsv_last_error());
+    return 1;
+  }
+  int32_t out[64];
+  int n = ffsv_get_output(pair, g, out, 64);
+  if (n <= 0) {
+    fprintf(stderr, "no output: %s\n", ffsv_last_error());
+    return 1;
+  }
+  printf("spec request %ld ->", g);
+  for (int i = 0; i < n && i < 64; i++) printf(" %d", out[i]);
+  printf("\nC spec_infer OK\n");
+  ffsv_release(pair);
+  ffsv_release(cfg);
+  return 0;
+}

@@ -205,3 +205,43 @@ def generate(host: _ServingHost) -> int:
 
 def get_output(host: _ServingHost, request_id: int) -> List[int]:
     return host.results.get(int(request_id), [])
+
+
+class _SpecHost(_ServingHost):
+    """Verifier + draft SSMs (reference spec_infer main: one LLM, one or
+    more SSMs driven through RequestManager)."""
+
+    def __init__(self, model, ssms):
+        super().__init__(model)
+        self.ssms = ssms
+
+
+def spec_create(cfg, verifier_json: str, draft_json: str) -> _SpecHost:
+    """Build + compile a speculative-decoding pair (reference
+    inference/spec_infer/spec_infer.cc:201 builds the LLM in
+    TREE_VERIFY mode and its SSMs in BEAM_SEARCH mode). Both specs use
+    the llm_create JSON schema; a draft whose family/dims truncate the
+    verifier's shares its shallow weights automatically (per-layer-name
+    seeded init), matching the bench's truncation-draft construction."""
+    v = dict(json.loads(verifier_json))
+    v["mode"] = "tree"
+    d = dict(json.loads(draft_json))
+    d["mode"] = "spec"
+    verifier = llm_create(cfg, json.dumps(v))
+    draft = llm_create(cfg, json.dumps(d))
+    return _SpecHost(verifier.model, [draft.model])
+
+
+def generate_spec(host: _SpecHost, spec_depth: int) -> int:
+    """Speculative decoding for every pending request (reference
+    flexflow_model_generate on a spec-configured model). Returns the
+    number of finished requests. ``spec_depth`` must be >= 1 — the
+    RequestManager treats falsy depths as "use the maximum", which would
+    silently invert a C caller's 0-means-off intent."""
+    if int(spec_depth) < 1:
+        raise ValueError(f"spec_depth must be >= 1, got {spec_depth}")
+    results = host.rm.generate_spec_infer(host.model, host.ssms,
+                                          spec_depth=int(spec_depth))
+    for r in results:
+        host.results[r.guid] = [int(t) for t in r.output_tokens]
+    return len(results)

@@ -199,6 +199,15 @@ char *ffsv_config_get(void *cfg, const char *key);   /* caller frees */
  *  "mode":"inc|spec|tree", "weights_npz":"path" (optional)} */
 void *ffsv_llm_create(void *cfg, const char *spec_json);
 
+/* Speculative-decoding pair: verifier (tree-verify) + draft SSM
+ * (beam-search) — the reference's spec_infer main
+ * (inference/spec_infer/spec_infer.cc:201). Same JSON schema. */
+void *ffsv_spec_create(void *cfg, const char *verifier_json,
+                       const char *draft_json);
+/* spec_depth: draft-chain depth per round, must be >= 1 (returns -1
+ * otherwise; there is no 0-means-default). */
+int ffsv_generate_spec(void *llm, int spec_depth);
+
 /* Register a tokenized prompt; returns the request guid, or -1. */
 long ffsv_register_request(void *llm, const int32_t *tokens, int n_tokens,
                            int max_new_tokens);

@@ -153,6 +153,28 @@ long ffsv_register_request(void *llm, const int32_t *tokens, int n_tokens,
   return guid;
 }
 
+/* Build + compile a speculative-decoding pair: verifier (tree-verify
+ * mode) + draft SSM (beam-search mode) — the reference's spec_infer
+ * main (inference/spec_infer/spec_infer.cc:201). Both specs use the
+ * llm_create JSON schema; register requests and call
+ * ffsv_generate_spec on the returned handle. */
+void *ffsv_spec_create(void *cfg, const char *verifier_json,
+                       const char *draft_json) {
+  return call("spec_create", Py_BuildValue("(Oss)", (PyObject *)cfg,
+                                           verifier_json, draft_json));
+}
+
+/* Speculative decoding for every pending request. Returns finished
+ * count, or -1. */
+int ffsv_generate_spec(void *llm, int spec_depth) {
+  PyObject *r = call("generate_spec",
+                     Py_BuildValue("(Oi)", (PyObject *)llm, spec_depth));
+  if (!r) return -1;
+  long n = PyLong_AsLong(r);
+  Py_DECREF(r);
+  return (int)n;
+}
+
 /* Run incremental decoding for every pending request (reference
  * flexflow_model_generate). Returns finished-request count or -1. */
 int ffsv_generate(void *llm) {

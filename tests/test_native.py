@@ -447,3 +447,96 @@ def test_native_graph_builder_new_op_validation():
     y = gb.transpose(x, [1, 0])
     z = gb.cast(y, "float32")
     assert z >= 0
+
+
+@needs_native
+def test_ffsv_serving_abi_in_process():
+    """The ffsv_* serving ABI (reference flexflow_c.cc surface: config
+    parse/set, model build, request registration, generate) driven
+    through ctypes. ffsv_init sees an already-initialized interpreter
+    and imports capi_host into it, so the whole round trip runs
+    in-process — the embedded-host path is covered by the
+    examples/c/run_incr_decoding.py smoke test."""
+    import ctypes
+    import os
+
+    import pytest
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    lib_path = os.path.join(root, "native", "build",
+                            "libflexflow_tpu_serve.so")
+    import subprocess
+
+    r = subprocess.run(["make", "-C", os.path.join(root, "native")],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-500:]
+    if not os.path.exists(lib_path):
+        pytest.skip("serve library not built (no python dev files)")
+    lib = ctypes.PyDLL(lib_path)     # PyDLL: calls hold the GIL
+    c = ctypes
+    lib.ffsv_init.restype = c.c_int
+    lib.ffsv_init.argtypes = [c.c_char_p]
+    lib.ffsv_last_error.restype = c.c_char_p
+    lib.ffsv_config_create.restype = c.c_void_p
+    lib.ffsv_config_set.restype = c.c_int
+    lib.ffsv_config_set.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p]
+    lib.ffsv_llm_create.restype = c.c_void_p
+    lib.ffsv_llm_create.argtypes = [c.c_void_p, c.c_char_p]
+    lib.ffsv_register_request.restype = c.c_long
+    lib.ffsv_register_request.argtypes = [c.c_void_p,
+                                          c.POINTER(c.c_int32),
+                                          c.c_int, c.c_int]
+    lib.ffsv_generate.restype = c.c_int
+    lib.ffsv_generate.argtypes = [c.c_void_p]
+    lib.ffsv_get_output.restype = c.c_int
+    lib.ffsv_get_output.argtypes = [c.c_void_p, c.c_long,
+                                    c.POINTER(c.c_int32), c.c_int]
+    lib.ffsv_release.argtypes = [c.c_void_p]
+
+    assert lib.ffsv_init(root.encode()) == 0, lib.ffsv_last_error()
+    cfg = lib.ffsv_config_create()
+    assert cfg
+    for k, v in (("max_requests_per_batch", "2"),
+                 ("max_sequence_length", "64"),
+                 ("max_tokens_per_batch", "16"),
+                 ("kv_cache_dtype", "float32")):
+        assert lib.ffsv_config_set(cfg, k.encode(), v.encode()) == 0
+    # a typo'd boolean must be rejected, not silently stored as False
+    assert lib.ffsv_config_set(cfg, b"enable_fusion", b"ture") == -1
+
+    spec = (b'{"family": "llama", "mode": "inc", "model_config": {'
+            b'"vocab_size": 128, "hidden_size": 64, '
+            b'"intermediate_size": 128, "num_hidden_layers": 2, '
+            b'"num_attention_heads": 4, "num_key_value_heads": 2, '
+            b'"max_position_embeddings": 64}}')
+    llm = lib.ffsv_llm_create(cfg, spec)
+    assert llm, lib.ffsv_last_error()
+    prompt = (c.c_int32 * 3)(5, 9, 23)
+    guid = lib.ffsv_register_request(llm, prompt, 3, 4)
+    assert guid >= 0
+    assert lib.ffsv_generate(llm) == 1, lib.ffsv_last_error()
+    out = (c.c_int32 * 16)()
+    n = lib.ffsv_get_output(llm, guid, out, 16)
+    assert n >= 4, lib.ffsv_last_error()
+    # cross-check against the pure-Python path: same config/spec/seed
+    # must produce the same tokens
+    import flexflow_tpu as ff
+    from flexflow_tpu.ffconst import CompMode, InferenceMode
+    from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+    from flexflow_tpu.serve.request_manager import RequestManager
+
+    m = ff.FFModel(ff.FFConfig(max_requests_per_batch=2,
+                               max_sequence_length=64,
+                               max_tokens_per_batch=16,
+                               kv_cache_dtype="float32"))
+    create_llama_model(m, LLAMAConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64), InferenceMode.INC_DECODING_MODE)
+    m.compile(comp_mode=CompMode.COMP_MODE_INFERENCE)
+    rm = RequestManager()
+    rm.register_new_request([5, 9, 23], max_new_tokens=4)
+    ref = rm.generate_incr_decoding(m)[0].output_tokens
+    assert list(out[:n]) == [int(t) for t in ref]
+    lib.ffsv_release(llm)
+    lib.ffsv_release(cfg)
