@@ -245,3 +245,40 @@ def generate_spec(host: _SpecHost, spec_depth: int) -> int:
     for r in results:
         host.results[r.guid] = [int(t) for t in r.output_tokens]
     return len(results)
+
+
+# ---------------------------------------------------------------------------
+# text prompts (reference flexflow_model_generate takes TEXT; the C++
+# tokenizer encodes/decodes around the token-level engine)
+# ---------------------------------------------------------------------------
+
+def register_bpe_tokenizer(host: _ServingHost, vocab_path: str,
+                           merges_path: str) -> int:
+    """Attach the (native C++ when available) GPT-2 BPE tokenizer so the
+    host can take text prompts. Returns the vocab size."""
+    from flexflow_tpu.native.tokenizer import BPETokenizer
+
+    tok = BPETokenizer(vocab_path=vocab_path, merges_path=merges_path)
+    host.rm.register_tokenizer(tok)
+    return tok.vocab_size()
+
+
+def register_request_text(host: _ServingHost, text: str,
+                          max_new_tokens: int) -> int:
+    return host.rm.register_new_request(text,
+                                        max_new_tokens=int(max_new_tokens))
+
+
+def get_output_text(host: _ServingHost, request_id: int) -> str:
+    """Decoded output of a FINISHED request. Unknown/unfinished guids
+    raise (the C side surfaces NULL + ffsv_last_error) so an empty
+    decode is distinguishable from a wrong guid. Reuses the
+    RequestManager's own collected GenerationResult.output_text — one
+    decode path, not two."""
+    rid = int(request_id)
+    res = host.rm.results.get(rid)
+    if res is None:
+        raise KeyError(f"no finished request with guid {rid}")
+    if host.rm.tokenizer is None:
+        raise ValueError("no tokenizer registered")
+    return res.output_text or host.rm.tokenizer.decode(res.output_tokens)

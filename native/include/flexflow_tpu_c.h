@@ -218,6 +218,17 @@ int ffsv_generate(void *llm);
  * (recall with more room if it exceeds cap), or -1. */
 int ffsv_get_output(void *llm, long guid, int32_t *out, int cap);
 
+/* Text surface (reference flexflow_model_generate takes text): attach
+ * the GPT-2 BPE tokenizer (returns vocab size or -1), register text
+ * prompts, fetch decoded text (malloc'd; caller frees). An unknown or
+ * unfinished guid returns NULL (see ffsv_last_error), so empty text is
+ * always a real, finished result. */
+int ffsv_register_bpe_tokenizer(void *llm, const char *vocab_json_path,
+                                const char *merges_path);
+long ffsv_register_request_text(void *llm, const char *text,
+                                int max_new_tokens);
+char *ffsv_get_output_text(void *llm, long guid);
+
 #ifdef __cplusplus
 }
 #endif

@@ -51,7 +51,14 @@ void set_error_from_python() {
 }
 
 PyObject *call(const char *fn, PyObject *args) {
-  // args: a NEW reference to a tuple (stolen here), or nullptr for ()
+  // args: a NEW reference to a tuple (stolen here), or nullptr for ().
+  // A nullptr WITH a pending exception means the caller's Py_BuildValue
+  // failed (e.g. non-UTF-8 text) — surface that error instead of
+  // invoking the function zero-arg under a pending exception.
+  if (!args && PyErr_Occurred()) {
+    set_error_from_python();
+    return nullptr;
+  }
   if (!g_host) {
     g_error = "ffsv_init not called";
     Py_XDECREF(args);
@@ -183,6 +190,45 @@ int ffsv_generate(void *llm) {
   long n = PyLong_AsLong(r);
   Py_DECREF(r);
   return (int)n;
+}
+
+/* Attach the GPT-2 BPE tokenizer (native C++ when available) so the
+ * host takes text prompts — reference flexflow_model_generate's text
+ * surface. Returns the vocab size, or -1. */
+int ffsv_register_bpe_tokenizer(void *llm, const char *vocab_json_path,
+                                const char *merges_path) {
+  PyObject *r = call("register_bpe_tokenizer",
+                     Py_BuildValue("(Oss)", (PyObject *)llm,
+                                   vocab_json_path, merges_path));
+  if (!r) return -1;
+  long n = PyLong_AsLong(r);
+  Py_DECREF(r);
+  return (int)n;
+}
+
+/* Register a TEXT prompt (requires a registered tokenizer); returns the
+ * request guid, or -1. */
+long ffsv_register_request_text(void *llm, const char *text,
+                                int max_new_tokens) {
+  PyObject *r = call("register_request_text",
+                     Py_BuildValue("(Osi)", (PyObject *)llm, text,
+                                   max_new_tokens));
+  if (!r) return -1;
+  long guid = PyLong_AsLong(r);
+  Py_DECREF(r);
+  return guid;
+}
+
+/* Decode a finished request's output to text (malloc'd; caller frees),
+ * or NULL. */
+char *ffsv_get_output_text(void *llm, long guid) {
+  PyObject *r = call("get_output_text",
+                     Py_BuildValue("(Ol)", (PyObject *)llm, guid));
+  if (!r) return nullptr;
+  const char *c = PyUnicode_AsUTF8(r);
+  char *out = c ? strdup(c) : nullptr;
+  Py_DECREF(r);
+  return out;
 }
 
 /* Copy a finished request's output tokens into out (cap entries max);

@@ -538,5 +538,52 @@ def test_ffsv_serving_abi_in_process():
     rm.register_new_request([5, 9, 23], max_new_tokens=4)
     ref = rm.generate_incr_decoding(m)[0].output_tokens
     assert list(out[:n]) == [int(t) for t in ref]
+
+    # text surface (reference flexflow_model_generate takes TEXT): a
+    # toy byte-level vocab round-trips prompt -> tokens -> text
+    import json as _json
+    import tempfile
+
+    from flexflow_tpu.native.tokenizer import _bytes_to_unicode
+
+    lib.ffsv_register_bpe_tokenizer.restype = c.c_int
+    lib.ffsv_register_bpe_tokenizer.argtypes = [c.c_void_p, c.c_char_p,
+                                                c.c_char_p]
+    lib.ffsv_register_request_text.restype = c.c_long
+    lib.ffsv_register_request_text.argtypes = [c.c_void_p, c.c_char_p,
+                                               c.c_int]
+    lib.ffsv_get_output_text.restype = c.c_void_p
+    lib.ffsv_get_output_text.argtypes = [c.c_void_p, c.c_long]
+    bu = _bytes_to_unicode()
+    vocab = {ch: i for i, ch in enumerate(bu.values())}
+    vocab["<|endoftext|>"] = len(vocab)
+    with tempfile.TemporaryDirectory() as td:
+        vp = os.path.join(td, "vocab.json")
+        mp = os.path.join(td, "merges.txt")
+        with open(vp, "w") as f:
+            _json.dump(vocab, f)
+        open(mp, "w").write("")
+        spec_t = _json.dumps({
+            "family": "llama", "mode": "inc", "model_config": {
+                "vocab_size": len(vocab), "hidden_size": 64,
+                "intermediate_size": 128, "num_hidden_layers": 2,
+                "num_attention_heads": 4, "num_key_value_heads": 2,
+                "max_position_embeddings": 64}}).encode()
+        tl = lib.ffsv_llm_create(cfg, spec_t)
+        assert tl, lib.ffsv_last_error()
+        assert lib.ffsv_register_bpe_tokenizer(
+            tl, vp.encode(), mp.encode()) == len(vocab)
+        tg = lib.ffsv_register_request_text(tl, b"hello tpu", 4)
+        assert tg >= 0, lib.ffsv_last_error()
+        assert lib.ffsv_generate(tl) == 1, lib.ffsv_last_error()
+        # unknown guid must be a NULL error, not an empty string
+        assert not lib.ffsv_get_output_text(tl, 999999)
+        ptr = lib.ffsv_get_output_text(tl, tg)
+        assert ptr, lib.ffsv_last_error()
+        assert len(ctypes.string_at(ptr).decode()) > 0
+        libc = ctypes.CDLL(None)
+        libc.free.argtypes = [ctypes.c_void_p]
+        libc.free(ptr)                  # header contract: caller frees
+        lib.ffsv_release(tl)
     lib.ffsv_release(llm)
     lib.ffsv_release(cfg)
