@@ -52,16 +52,18 @@ void set_error_from_python() {
 
 PyObject *call(const char *fn, PyObject *args) {
   // args: a NEW reference to a tuple (stolen here), or nullptr for ().
+  if (!g_host) {
+    // checked FIRST: before ffsv_init there may be no interpreter, and
+    // PyErr_Occurred without a thread state would crash
+    g_error = "ffsv_init not called";
+    Py_XDECREF(args);
+    return nullptr;
+  }
   // A nullptr WITH a pending exception means the caller's Py_BuildValue
   // failed (e.g. non-UTF-8 text) — surface that error instead of
   // invoking the function zero-arg under a pending exception.
   if (!args && PyErr_Occurred()) {
     set_error_from_python();
-    return nullptr;
-  }
-  if (!g_host) {
-    g_error = "ffsv_init not called";
-    Py_XDECREF(args);
     return nullptr;
   }
   PyObject *f = PyObject_GetAttrString(g_host, fn);

@@ -539,6 +539,24 @@ def test_ffsv_serving_abi_in_process():
     ref = rm.generate_incr_decoding(m)[0].output_tokens
     assert list(out[:n]) == [int(t) for t in ref]
 
+    # spec surface: depth < 1 must be rejected (falsy would silently
+    # mean "maximum depth" in the Python layer)
+    lib.ffsv_spec_create.restype = c.c_void_p
+    lib.ffsv_spec_create.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p]
+    lib.ffsv_generate_spec.restype = c.c_int
+    lib.ffsv_generate_spec.argtypes = [c.c_void_p, c.c_int]
+    pair = lib.ffsv_spec_create(cfg, spec, spec)
+    assert pair, lib.ffsv_last_error()
+    assert lib.ffsv_generate_spec(pair, 0) == -1
+    assert b"spec_depth" in lib.ffsv_last_error()
+    prompt2 = (c.c_int32 * 3)(5, 9, 23)
+    g2 = lib.ffsv_register_request(pair, prompt2, 3, 4)
+    assert g2 >= 0 and lib.ffsv_generate_spec(pair, 2) == 1, \
+        lib.ffsv_last_error()
+    n2 = lib.ffsv_get_output(pair, g2, out, 16)
+    assert n2 >= 4
+    lib.ffsv_release(pair)
+
     # text surface (reference flexflow_model_generate takes TEXT): a
     # toy byte-level vocab round-trips prompt -> tokens -> text
     import json as _json
