@@ -223,6 +223,30 @@ def run_requests(fn, prompts, new_tokens):
     return out_tokens / dt, results
 
 
+def latency_stats(results, prefix=""):
+    """p50/p99 request + per-token latency over one timed pass, from the
+    per-request latency fields the RequestManager stamps on every
+    GenerationResult (telemetry subsystem; exact percentiles, same math
+    as the ffsv_request_latency_seconds histogram). Under continuous
+    batching all N requests run concurrently, so request latency ~= the
+    pass wall time and the p50/p99 gap exposes scheduling skew."""
+    from flexflow_tpu.telemetry.metrics import percentile
+
+    lats = sorted(r.latency_s for r in results if r.latency_s > 0)
+    if not lats:
+        return {}
+    per_tok = sorted(r.latency_s / max(1, len(r.output_tokens))
+                     for r in results if r.latency_s > 0)
+    return {
+        f"{prefix}request_latency_p50_s": round(percentile(lats, 50), 4),
+        f"{prefix}request_latency_p99_s": round(percentile(lats, 99), 4),
+        f"{prefix}per_token_latency_p50_ms":
+            round(1e3 * percentile(per_tok, 50), 4),
+        f"{prefix}per_token_latency_p99_ms":
+            round(1e3 * percentile(per_tok, 99), 4),
+    }
+
+
 def decode_roofline(llm, ifm, steps: int = None) -> dict:
     """Time the fused decode block alone and compare to its HBM stream
     bound: every step reads the full (quantized) weight set minus the
@@ -575,6 +599,11 @@ def main():
         "spec_matches_incr_first30": f"{m30}/{len(spec_res)}",
         f"spec_matches_incr_first{NEW_TOKENS}":
             f"{m_full}/{len(spec_res)}",
+        # tail latency of the headline (spec) and baseline (incr) passes
+        # next to the throughput line (ROADMAP item 2's load story reads
+        # p50/p99 from here)
+        **latency_stats(spec_res),
+        **latency_stats(incr_res, "incr_"),
         # measured acceptance — the rate the headline was achieved at
         **meter.stats(),
         **({"acceptance_sweep": sweep} if sweep else {}),

@@ -143,6 +143,14 @@ class FFConfig:
     profiling: bool = False
     perform_fusion_checks: bool = False
     log_instance_creation: bool = False
+    # serving telemetry (flexflow_tpu/telemetry): enables the global
+    # metrics registry + per-request span tracing at LLM.compile /
+    # ffsv_llm_create — the runtime counterpart of the reference's two
+    # profiling layers. Off by default: the disabled decode path records
+    # nothing. telemetry_trace_path writes the JSONL span trace
+    # (Perfetto-loadable via export_chrome_trace).
+    telemetry: bool = False
+    telemetry_trace_path: str = ""
 
     # --- TPU specifics (no reference equivalent) ---
     mesh_shape: Optional[Sequence[int]] = None   # overrides degree-derived mesh

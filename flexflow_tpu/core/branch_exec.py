@@ -101,10 +101,14 @@ def build_branch_plan(model) -> Optional[BranchPlan]:
             if (getattr(st, "branch_alloc", None) is not None
                     or getattr(st, "branch_axis", "data") != "data"):
                 # unequal or non-data-axis splits have no equal-slice
-                # shard_map plan (per-device shapes would differ) —
-                # execute sequentially; branch_parallel_apply(allocs=...)
-                # covers the unequal form for explicit use
-                return None
+                # shard_map plan (per-device shapes would differ):
+                # leave THIS op untagged so only the region it belongs
+                # to falls back to sequential execution — other valid
+                # equal-slice regions in the same strategy still plan
+                # (ADVICE r5: returning None here disabled them all);
+                # branch_parallel_apply(allocs=...) covers the unequal
+                # form for explicit use
+                continue
             tags[ly.name] = st.branch
 
     if not tags:

@@ -27,10 +27,16 @@ from flexflow_tpu.serve.request_manager import (
 )
 from flexflow_tpu.serve.inference_manager import InferenceManager
 from flexflow_tpu.serve.api import LLM, SSM, init
+from flexflow_tpu.telemetry import (ServingTelemetry, disable_telemetry,
+                                    enable_telemetry, get_telemetry)
 
 __all__ = [
     "LLM",
     "SSM",
+    "ServingTelemetry",
+    "disable_telemetry",
+    "enable_telemetry",
+    "get_telemetry",
     "init",
     "BatchMeta",
     "TreeBatchMeta",

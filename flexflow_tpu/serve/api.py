@@ -170,6 +170,14 @@ class LLM:
         config = FFConfig(max_requests_per_batch=max_requests_per_batch,
                           max_sequence_length=max_seq_length,
                           max_tokens_per_batch=max_tokens_per_batch, **kw)
+        if config.telemetry:
+            # enable-or-keep the process-global telemetry (an enabled
+            # instance's registry survives; SSM.compile reuses the
+            # verifier's kwargs so this runs once per model) and attach
+            # the requested trace path to the live tracer
+            from flexflow_tpu.telemetry import ensure_telemetry
+
+            ensure_telemetry(config.telemetry_trace_path or None)
 
         from flexflow_tpu.core.model import FFModel
 
@@ -280,6 +288,32 @@ class LLM:
         if srv is not None:
             srv.stop()
             self._server = None
+        return self
+
+    # ------------------------------------------------------------------
+    def start_metrics_server(self, port: int = 9600,
+                             host: str = "127.0.0.1"):
+        """Expose the telemetry registry over HTTP: ``GET /metrics``
+        (Prometheus text) and ``GET /metrics.json``. Enables telemetry if
+        it is not on yet (an endpoint over a dead registry is useless).
+        ``port=0`` binds an ephemeral port; the bound port is on the
+        returned server object (``.port``) and ``self._metrics_server``.
+        """
+        from flexflow_tpu.telemetry import (MetricsHTTPServer,
+                                            ensure_telemetry, get_telemetry)
+
+        ensure_telemetry()
+        if getattr(self, "_metrics_server", None) is None:
+            self._metrics_server = MetricsHTTPServer(
+                lambda: getattr(get_telemetry(), "registry", None),
+                host=host, port=port)
+        return self._metrics_server
+
+    def stop_metrics_server(self):
+        srv = getattr(self, "_metrics_server", None)
+        if srv is not None:
+            srv.stop()
+            self._metrics_server = None
         return self
 
 

@@ -38,7 +38,14 @@ def _maybe_force_platform():
             pass
 
 
-_maybe_force_platform()
+def host_init() -> int:
+    """Embedded-host initialization, called by ``ffsv_init`` AFTER the
+    module import (ADVICE r5: the platform override used to run at
+    import time, so merely importing this module from an ordinary Python
+    process silently mutated the session's global JAX backend — now only
+    a genuinely embedding C host triggers it)."""
+    _maybe_force_platform()
+    return 0
 
 
 # ---------------------------------------------------------------------------
@@ -172,6 +179,13 @@ def llm_create(cfg, spec_json: str) -> _ServingHost:
     mode = {"inc": InferenceMode.INC_DECODING_MODE,
             "spec": InferenceMode.BEAM_SEARCH_MODE,
             "tree": InferenceMode.TREE_VERIFY_MODE}[spec.get("mode", "inc")]
+    if getattr(cfg, "telemetry", False):
+        # C hosts opt in via ffsv_config_set(cfg, "telemetry", "true")
+        # (+ optional telemetry_trace_path) and read snapshots back
+        # through ffsv_metrics_dump
+        from flexflow_tpu.telemetry import ensure_telemetry
+
+        ensure_telemetry(getattr(cfg, "telemetry_trace_path", "") or None)
     model = ff.FFModel(cfg)
     create(model, mcfg, mode)
     model.compile(comp_mode=CompMode.COMP_MODE_INFERENCE)
@@ -267,6 +281,27 @@ def register_request_text(host: _ServingHost, text: str,
                           max_new_tokens: int) -> int:
     return host.rm.register_new_request(text,
                                         max_new_tokens=int(max_new_tokens))
+
+
+def metrics_dump(fmt: str = "json") -> str:
+    """Snapshot the global telemetry registry (``ffsv_metrics_dump``).
+
+    ``fmt``: "json" (structured snapshot incl. exact p50/p90/p99 per
+    histogram) or "prometheus" (text exposition format). Returns an
+    EMPTY snapshot ("{}" / "") when telemetry is disabled — a C host can
+    distinguish "off" from "on with no traffic" by the presence of the
+    ffsv_requests_total key. Unknown formats raise (surfaces as NULL +
+    ffsv_last_error)."""
+    from flexflow_tpu.telemetry import get_telemetry
+
+    if fmt not in ("json", "prometheus"):
+        raise ValueError(f"unknown metrics format {fmt!r}; "
+                         "use 'json' or 'prometheus'")
+    tel = get_telemetry()
+    if tel is None:
+        return "{}" if fmt == "json" else ""
+    return (tel.registry.to_json() if fmt == "json"
+            else tel.registry.to_prometheus())
 
 
 def get_output_text(host: _ServingHost, request_id: int) -> str:

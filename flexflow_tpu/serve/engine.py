@@ -25,6 +25,7 @@ Two engines:
 
 from __future__ import annotations
 
+import time
 from typing import Optional, Tuple
 
 import numpy as np
@@ -34,6 +35,14 @@ import jax.numpy as jnp
 
 from flexflow_tpu.ops.base import OpContext
 from flexflow_tpu.serve.batch_config import BatchMeta
+from flexflow_tpu.telemetry import get_telemetry
+
+
+def _resolve_tel(explicit):
+    """Engine-side telemetry resolution: an explicitly injected
+    ServingTelemetry (RequestManager hands its own through
+    ``engine.telemetry``) wins over the process-global one."""
+    return explicit if explicit is not None else get_telemetry()
 
 
 def build_feeds(model, meta):
@@ -257,6 +266,7 @@ class MultiSpecEngine:
             s.finalize_gemm_fusion()
         self.depth = depth
         self.max_rounds = max_rounds
+        self.telemetry = None   # explicit ServingTelemetry; None -> global
         self._compute_dtype = jnp.dtype(llm.config.compute_dtype)
         nssm = len(self.ssms)
         self._block = jax.jit(
@@ -502,11 +512,17 @@ class MultiSpecEngine:
             args += [s.params, s.op_state]
         args += [jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(active),
                  jnp.int32(n_rounds), jnp.asarray(remaining, jnp.int32)]
+        tel = _resolve_tel(self.telemetry)
+        t0 = time.perf_counter()
         llm_state, ssm_states, packed = self._block(*args)
         self.llm.op_state = llm_state
         for s, st in zip(self.ssms, ssm_states):
             s.op_state = st
         packed = np.asarray(packed)
+        if tel is not None:     # the np readback above is the device fence
+            tel.record_spec_block(time.perf_counter() - t0,
+                                  packed[:, :, -1], self.depth,
+                                  self.tree_width)
         return packed[:, :, :-1], packed[:, :, -1]
 
 
@@ -530,6 +546,7 @@ class SpecChainEngine:
         ssm.finalize_gemm_fusion()
         self.depth = depth
         self.max_rounds = max_rounds
+        self.telemetry = None   # explicit ServingTelemetry; None -> global
         self._compute_dtype = jnp.dtype(llm.config.compute_dtype)
         self._block = jax.jit(self._block_impl, donate_argnums=(1, 3))
         # concrete (created outside any trace: jit closes over it as a const)
@@ -632,12 +649,18 @@ class SpecChainEngine:
         if remaining is None:
             remaining = np.full(tok.shape, np.iinfo(np.int32).max // 2,
                                 np.int32)
+        tel = _resolve_tel(self.telemetry)
+        t0 = time.perf_counter()
         (self.llm.op_state, self.ssm.op_state, packed) = self._block(
             self.llm.params, self.llm.op_state, self.ssm.params,
             self.ssm.op_state, jnp.asarray(tok), jnp.asarray(pos),
             jnp.asarray(active), jnp.int32(n_rounds),
             jnp.asarray(remaining, dtype=jnp.int32))
         packed = np.asarray(packed)
+        if tel is not None:     # the np readback above is the device fence
+            tel.record_spec_block(time.perf_counter() - t0,
+                                  packed[:, :, -1], self.depth,
+                                  self.depth + 1)
         return packed[:, :, :-1], packed[:, :, -1]
 
 
@@ -683,6 +706,7 @@ class BeamSpecEngine:
         self.depth = depth
         self.width = width
         self.max_rounds = max_rounds
+        self.telemetry = None   # explicit ServingTelemetry; None -> global
         self._compute_dtype = jnp.dtype(llm.config.compute_dtype)
         from flexflow_tpu.kernels.attention import SUBLANE, round_up
 
@@ -912,10 +936,16 @@ class BeamSpecEngine:
         if remaining is None:
             remaining = np.full(tok.shape, np.iinfo(np.int32).max // 2,
                                 np.int32)
+        tel = _resolve_tel(self.telemetry)
+        t0 = time.perf_counter()
         (self.llm.op_state, self.ssm.op_state, packed) = self._block(
             self.llm.params, self.llm.op_state, self.ssm.params,
             self.ssm.op_state, jnp.asarray(tok), jnp.asarray(pos),
             jnp.asarray(active), jnp.int32(n_rounds),
             jnp.asarray(remaining, jnp.int32))
         packed = np.asarray(packed)
+        if tel is not None:     # the np readback above is the device fence
+            tel.record_spec_block(time.perf_counter() - t0,
+                                  packed[:, :, -1], self.depth,
+                                  self.tree_width)
         return packed[:, :, :-1], packed[:, :, -1]

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -45,6 +46,7 @@ from flexflow_tpu.serve.batch_config import (
 )
 from flexflow_tpu.serve.inference_manager import InferenceManager
 from flexflow_tpu.ops.inc_attention import commit_tree_kv
+from flexflow_tpu.telemetry import get_telemetry
 
 
 @dataclasses.dataclass
@@ -60,6 +62,11 @@ class Request:
     cache_depth: int = 0                  # verifier/incr cache depth
     ssm_cache_depth: Dict[int, int] = dataclasses.field(default_factory=dict)
     finished: bool = False
+    # lifecycle timestamps (time.perf_counter; always recorded — two
+    # clock reads per request lifetime — so GenerationResult latency
+    # fields exist even with telemetry disabled)
+    arrival_s: float = 0.0
+    first_token_s: float = 0.0
 
     def __post_init__(self):
         if not self.tokens:
@@ -79,6 +86,13 @@ class GenerationResult:
     output_tokens: List[int]
     input_text: str = ""
     output_text: str = ""
+    # per-request latency (reference serving writes latency per request
+    # to -output-file; here it rides on the result object): admission ->
+    # finish, and admission -> first generated token (0.0 when the path
+    # cannot attribute first-token time, e.g. the native scheduler owns
+    # the token bookkeeping)
+    latency_s: float = 0.0
+    ttft_s: float = 0.0
 
 
 class RequestManager:
@@ -87,7 +101,8 @@ class RequestManager:
     _guid_counter = itertools.count(1000000)
 
     def __init__(self, tokenizer=None, eos_token_id: Optional[int] = None,
-                 max_requests_per_batch: Optional[int] = None):
+                 max_requests_per_batch: Optional[int] = None,
+                 telemetry=None):
         self.tokenizer = tokenizer
         self.eos_token_id = eos_token_id
         self.pending: deque = deque()
@@ -95,6 +110,13 @@ class RequestManager:
         self.max_spec_depth = MAX_BEAM_DEPTH
         self._commit = jax.jit(commit_tree_kv, donate_argnums=(0,))
         self.output_filepath: Optional[str] = None
+        # explicit ServingTelemetry, or None -> the process-global one
+        # (resolved per loop iteration, so enabling mid-session attaches)
+        self.telemetry = telemetry
+
+    def _tel(self):
+        return self.telemetry if self.telemetry is not None \
+            else get_telemetry()
 
     def register_output_filepath(self, path: str):
         """Per-request output log (reference register_output_filepath :155:
@@ -121,7 +143,11 @@ class RequestManager:
         guid = next(self._guid_counter)
         self.pending.append(Request(guid=guid, prompt_tokens=toks,
                                     max_new_tokens=max_new_tokens,
-                                    max_sequence_length=max_sequence_length))
+                                    max_sequence_length=max_sequence_length,
+                                    arrival_s=time.perf_counter()))
+        tel = self._tel()
+        if tel is not None:
+            tel.note_admission(guid, len(toks), max_new_tokens)
         return guid
 
     # -- scheduling helpers ------------------------------------------------
@@ -138,9 +164,17 @@ class RequestManager:
 
     def _collect(self, req: Request) -> GenerationResult:
         out = req.tokens[len(req.prompt_tokens):]
-        res = GenerationResult(guid=req.guid,
-                               input_tokens=list(req.prompt_tokens),
-                               output_tokens=out)
+        now = time.perf_counter()
+        res = GenerationResult(
+            guid=req.guid,
+            input_tokens=list(req.prompt_tokens),
+            output_tokens=out,
+            latency_s=(now - req.arrival_s) if req.arrival_s else 0.0,
+            ttft_s=(req.first_token_s - req.arrival_s)
+            if req.first_token_s and req.arrival_s else 0.0)
+        tel = self._tel()
+        if tel is not None:
+            tel.note_finish(req.guid, len(out), res.latency_s, res.ttft_s)
         if self.tokenizer is not None:
             try:
                 res.input_text = self.tokenizer.decode(res.input_tokens)
@@ -174,6 +208,48 @@ class RequestManager:
         limit = min(req.max_sequence_length or max_seq, max_seq)
         return max(1, min(req.max_new_tokens - req.num_generated,
                           limit - len(req.tokens)))
+
+    # -- telemetry hooks (all no-ops when telemetry is disabled) -----------
+    @staticmethod
+    def _note_first_token(req: Request):
+        if not req.first_token_s and req.num_generated > 0:
+            req.first_token_s = time.perf_counter()
+
+    def _timed_prefill(self, ifm, meta, tel, rows=(), active=None,
+                       n_tokens=None):
+        """One prefill step, optionally wall-clocked. The step's outputs
+        are discarded (want_output=False dispatches asynchronously), so
+        honest timing needs an explicit readback fence on the new
+        op_state (utils/profiling.device_fence — block_until_ready lies
+        through the axon tunnel). The fence only runs with telemetry
+        enabled; the disabled path keeps the async overlap.
+
+        ``rows``/``active`` feed per-request prefill spans; paths whose
+        slot->request mapping lives elsewhere (the native scheduler)
+        pass ``n_tokens`` alone and get metrics without spans."""
+        if tel is None:
+            ifm.step(meta, want_output=False)
+            return
+        from flexflow_tpu.utils.profiling import device_fence
+
+        t0 = time.perf_counter()
+        ifm.step(meta, want_output=False)
+        device_fence(ifm.model.op_state)
+        if n_tokens is None:
+            n_tokens = sum(len(chunk) for _, chunk, _ in rows)
+        tel.record_prefill(time.perf_counter() - t0, n_tokens,
+                           [(active[slot].guid, sp, len(chunk))
+                            for slot, chunk, sp in rows]
+                           if active is not None else ())
+
+    def _tel_tick(self, tel, live, slots: int, max_seq: int):
+        """Once per scheduling tick that dispatches decode/spec work:
+        queue depth, batch occupancy, KV-cache utilization."""
+        if tel is None:
+            return
+        kv = (sum(len(r.tokens) for r in live)
+              / (len(live) * max_seq)) if live else None
+        tel.note_batch(len(self.pending), len(live), slots, kv)
 
     # -- batch assembly ----------------------------------------------------
     @staticmethod
@@ -240,13 +316,15 @@ class RequestManager:
         done: List[GenerationResult] = []
 
         while self.pending or any(a is not None for a in active):
+            tel = self._tel()
             self._fill_slots(active, max_seq, done)
             rows = self._prefill_rows(active, chunk,
                                       lambda r: r.cache_depth,
                                       cfg.max_tokens_per_batch)
             if rows:
                 meta = self._meta_from_rows(R, chunk, rows)
-                ifm.step(meta, want_output=False)  # non-final chunk outputs unused
+                # non-final chunk outputs unused
+                self._timed_prefill(ifm, meta, tel, rows, active)
                 for slot, chunk_toks, sp in rows:
                     active[slot].cache_depth = sp + len(chunk_toks)
                 continue
@@ -274,12 +352,19 @@ class RequestManager:
                 # never scan past the KV cache end
                 block = max(1, min(block,
                                    max_seq - 1 - int(pos[act].max())))
+                self._tel_tick(tel, live, R, max_seq)
+                t0 = time.perf_counter()
                 toks = ifm.decode_block(tok, pos, act, block)
+                if tel is not None:   # decode_block's np readback = fence
+                    tel.record_decode_block(time.perf_counter() - t0,
+                                            block, len(live),
+                                            [r.guid for r in live])
                 for req in live:
                     for j in range(block):
                         req.tokens.append(int(toks[req.slot, j]))
                         if self._finish_if_done(req, max_seq):
                             break
+                    self._note_first_token(req)
                     req.cache_depth = len(req.tokens) - 1
             for slot in range(R):
                 req = active[slot]
@@ -316,19 +401,34 @@ class RequestManager:
                 done.append(self._collect(req))
 
         while sched.has_work():
+            tel = self._tel()
             sched.fill_slots()
             drain()  # over-long prompts rejected straight to done
             rows, tokens, positions, start, num, act = \
                 sched.assemble_prefill(chunk, cfg.max_tokens_per_batch, chunk)
             if rows:
-                ifm.step(BatchMeta(tokens=tokens, positions=positions,
-                                   start_pos=start, num_tokens=num,
-                                   active=act), want_output=False)
+                meta = BatchMeta(tokens=tokens, positions=positions,
+                                 start_pos=start, num_tokens=num,
+                                 active=act)
+                # the native scheduler owns slot->guid bookkeeping, so
+                # no per-request prefill spans on this path
+                self._timed_prefill(ifm, meta, tel,
+                                    n_tokens=int(np.asarray(num).sum()))
                 continue
             live, tok, pos, act = sched.assemble_decode()
             if live:
                 block = sched.decode_block(cfg.decode_block_steps)
+                if tel is not None:
+                    # self.pending drained into the C++ scheduler up
+                    # front: its queue depth = registered - finished -
+                    # requests currently holding a live slot
+                    tel.note_batch(max(0, len(reqs) - len(done) - live),
+                                   live, R, None)
+                t0 = time.perf_counter()
                 toks = ifm.decode_block(tok, pos, act, block)
+                if tel is not None:
+                    tel.record_decode_block(time.perf_counter() - t0,
+                                            block, live)
                 sched.append_block(np.asarray(toks)[:, :block])
             drain()
         return done
@@ -432,6 +532,7 @@ class RequestManager:
             return lambda r: r.ssm_cache_depth.get(i, 0)
 
         while self.pending or any(a is not None for a in active):
+            tel = self._tel()
             self._fill_slots(active, max_seq, done)
             # ---- prompt prefill: verifier + every SSM ----
             prefilled = False
@@ -439,7 +540,7 @@ class RequestManager:
                                       cfg.max_tokens_per_batch)
             if rows:
                 meta = self._meta_from_rows(R, chunk, rows)
-                llm_ifm.step(meta, want_output=False)
+                self._timed_prefill(llm_ifm, meta, tel, rows, active)
                 for slot, toks, sp in rows:
                     active[slot].cache_depth = sp + len(toks)
                 prefilled = True
@@ -448,7 +549,7 @@ class RequestManager:
                                           cfg.max_tokens_per_batch)
                 if rows:
                     meta = self._meta_from_rows(R, chunk, rows)
-                    ifm.step(meta, want_output=False)
+                    self._timed_prefill(ifm, meta, tel, rows, active)
                     for slot, toks, sp in rows:
                         active[slot].ssm_cache_depth[i] = sp + len(toks)
                     prefilled = True
@@ -456,6 +557,10 @@ class RequestManager:
                 continue
             live = [req for req in active if req is not None and not req.finished]
             if live:
+                self._tel_tick(tel, live, R, max_seq)
+                if tel is not None:
+                    tel.draft_depth.set(depth)
+                    tel.tree_width.set(T)
                 # ---- draft phase: each SSM proposes chains (or beams) ----
                 chains: List[Dict[int, List[int]]] = []  # per branch: slot->toks
                 for i, ifm in enumerate(ssm_ifms):
@@ -503,7 +608,7 @@ class RequestManager:
                     trees[req.slot] = (node_tok, node_parent)
                 # ---- verify on the LLM ----
                 self._verify_and_commit(llm, llm_ifm, live, trees, R, T,
-                                        max_seq, depth)
+                                        max_seq, depth, tel=tel)
             for slot in range(R):
                 req = active[slot]
                 if req is not None and req.finished:
@@ -564,6 +669,7 @@ class RequestManager:
         done: List[GenerationResult] = []
 
         while self.pending or any(a is not None for a in active):
+            tel = self._tel()
             self._fill_slots(active, max_seq, done)
             # prompt prefill for both models (same path as incremental)
             prefilled = False
@@ -581,7 +687,7 @@ class RequestManager:
                             >= room_needed]
                 if rows:
                     meta = self._meta_from_rows(R, chunk, rows)
-                    ifm.step(meta, want_output=False)
+                    self._timed_prefill(ifm, meta, tel, rows, active)
                     for slot, toks, sp in rows:
                         if ifm is llm_ifm:
                             active[slot].cache_depth = sp + len(toks)
@@ -612,13 +718,19 @@ class RequestManager:
                     rows = [(req.slot, req.tokens[-1:], len(req.tokens) - 1)
                             for req in cramped]
                     meta = self._meta_from_rows(R, 1, rows)
+                    t0 = time.perf_counter()
                     out = llm_ifm.step(meta)
+                    if tel is not None:   # step's np readback = fence
+                        tel.record_decode_block(
+                            time.perf_counter() - t0, 1, len(cramped),
+                            [req.guid for req in cramped])
                     for slot, _t, sp in rows:
                         req = active[slot]
                         req.tokens.append(int(out[slot, 0]))
                         req.cache_depth = sp + 1
                         req.ssm_cache_depth[0] = min(
                             req.ssm_cache_depth.get(0, 0), sp)
+                        self._note_first_token(req)
                         self._finish_if_done(req, max_seq)
                 if draftable:
                     tok = np.zeros((R,), np.int32)
@@ -633,9 +745,17 @@ class RequestManager:
                         act[req.slot] = True
                         remaining[req.slot] = self._remaining_budget(req,
                                                                      max_seq)
+                    self._tel_tick(tel, draftable, R, max_seq)
+                    # engines are cached on the llm across managers:
+                    # hand THIS manager's explicit telemetry through (a
+                    # None keeps the engine on the process-global one)
+                    engine.telemetry = self.telemetry
+                    t0 = time.perf_counter()
                     a, n_acc = engine.run_block(tok, pos, act, rounds,
                                                 remaining)
+                    block_dt = time.perf_counter() - t0
                     for req in draftable:
+                        round_events = []
                         for k in range(rounds):
                             n = int(n_acc[req.slot, k])
                             if n < 0:     # request drafted nothing this round
@@ -652,8 +772,13 @@ class RequestManager:
                                 new_toks = new_toks[
                                     :new_toks.index(self.eos_token_id) + 1]
                             req.tokens.extend(new_toks)
+                            round_events.append((k, n, len(new_toks)))
                             if self._finish_if_done(req, max_seq):
                                 break
+                        self._note_first_token(req)
+                        if tel is not None and round_events:
+                            tel.trace_rounds(req.guid, round_events,
+                                             t0, block_dt, rounds)
                         d = len(req.tokens) - 1
                         req.cache_depth = d
                         req.ssm_cache_depth[0] = d
@@ -712,13 +837,14 @@ class RequestManager:
         room_needed = engine.tree_width
 
         while self.pending or any(a is not None for a in active):
+            tel = self._tel()
             self._fill_slots(active, max_seq, done)
             prefilled = False
             rows = self._prefill_rows(active, chunk, lambda r: r.cache_depth,
                                       cfg.max_tokens_per_batch)
             if rows:
                 meta = self._meta_from_rows(R, chunk, rows)
-                llm_ifm.step(meta, want_output=False)
+                self._timed_prefill(llm_ifm, meta, tel, rows, active)
                 for slot, toks, sp in rows:
                     active[slot].cache_depth = sp + len(toks)
                 prefilled = True
@@ -731,7 +857,7 @@ class RequestManager:
                         >= room_needed]
                 if rows:
                     meta = self._meta_from_rows(R, chunk, rows)
-                    ifm.step(meta, want_output=False)
+                    self._timed_prefill(ifm, meta, tel, rows, active)
                     for slot, toks, sp in rows:
                         active[slot].ssm_cache_depth[i] = sp + len(toks)
                     prefilled = True
@@ -751,7 +877,12 @@ class RequestManager:
                 rows = [(req.slot, req.tokens[-1:], len(req.tokens) - 1)
                         for req in cramped]
                 meta = self._meta_from_rows(R, 1, rows)
+                t0 = time.perf_counter()
                 out = llm_ifm.step(meta)
+                if tel is not None:       # step's np readback = fence
+                    tel.record_decode_block(time.perf_counter() - t0, 1,
+                                            len(cramped),
+                                            [req.guid for req in cramped])
                 for slot, _t, sp in rows:
                     req = active[slot]
                     req.tokens.append(int(out[slot, 0]))
@@ -759,6 +890,7 @@ class RequestManager:
                     for i in range(B):
                         req.ssm_cache_depth[i] = min(
                             req.ssm_cache_depth.get(i, 0), sp)
+                    self._note_first_token(req)
                     self._finish_if_done(req, max_seq)
             if draftable:
                 tok = np.zeros((R,), np.int32)
@@ -775,10 +907,15 @@ class RequestManager:
                     act[req.slot] = True
                     remaining[req.slot] = self._remaining_budget(req, max_seq)
                 rounds = min(cfg.spec_rounds_per_call, engine.max_rounds)
+                self._tel_tick(tel, draftable, R, max_seq)
+                engine.telemetry = self.telemetry   # see chain-path note
+                t0 = time.perf_counter()
                 toks, n_acc = engine.run_block(tok, pos, act, rounds,
                                                remaining)
+                block_dt = time.perf_counter() - t0
                 for req in draftable:
                     last_rpos = len(req.tokens) - 1
+                    round_events = []
                     for k in range(rounds):
                         n = int(n_acc[req.slot, k])
                         if n < 0:
@@ -793,8 +930,13 @@ class RequestManager:
                             new_toks = new_toks[
                                 :new_toks.index(self.eos_token_id) + 1]
                         req.tokens.extend(new_toks)
+                        round_events.append((k, n, len(new_toks)))
                         if self._finish_if_done(req, max_seq):
                             break
+                    self._note_first_token(req)
+                    if tel is not None and round_events:
+                        tel.trace_rounds(req.guid, round_events, t0,
+                                         block_dt, rounds)
                     d = len(req.tokens) - 1
                     # verifier cache: committed in-engine through the last
                     # accepted prefix (count = all but the pending token)
@@ -999,7 +1141,8 @@ class RequestManager:
             req.ssm_cache_depth[ssm_idx] -= (depth - 1)
         return chains
 
-    def _verify_and_commit(self, llm, ifm, live, trees, R, T, max_seq, depth):
+    def _verify_and_commit(self, llm, ifm, live, trees, R, T, max_seq, depth,
+                           tel=None):
         from flexflow_tpu.kernels.attention import SUBLANE, round_up
 
         T = round_up(T, SUBLANE)  # sublane-align the verify width (flash)
@@ -1027,7 +1170,10 @@ class RequestManager:
         meta = TreeBatchMeta(tokens=tokens, positions=positions,
                              parent=parent, ancestor=anc, start_pos=start,
                              num_nodes=num, active=act)
+        t0 = time.perf_counter()
         out = ifm.step(meta)                               # [R, T] argmax ids
+        if tel is not None:               # step's np readback = fence
+            tel.spec_block_seconds.observe(time.perf_counter() - t0)
         # ---- greedy acceptance walk ----
         src_node = np.zeros((R, self.max_spec_depth + 1), np.int32)
         ncommit = np.zeros((R,), np.int32)
@@ -1059,6 +1205,13 @@ class RequestManager:
             if self.eos_token_id is not None and self.eos_token_id in new_toks:
                 new_toks = new_toks[:new_toks.index(self.eos_token_id) + 1]
             req.tokens.extend(new_toks)
+            self._note_first_token(req)
+            if tel is not None:
+                # one host-stepped round: the per-round decode metrics the
+                # fused engines record in run_block (engine.py)
+                tel.spec_rounds.inc()
+                tel.acceptance_length.observe(len(path))
+                tel.tokens_per_round.observe(len(new_toks))
             req.cache_depth = min(start[req.slot] + 1 + len(path),
                                   len(req.tokens) - 1)
             self._finish_if_done(req, max_seq)

@@ -1,0 +1,262 @@
+"""Serving telemetry subsystem: metrics registry + per-request tracing.
+
+The instrumentation seam for the serving stack (ROADMAP items 1/2/5 all
+read from here): ``serve/request_manager.py`` and ``serve/engine.py``
+call the ``ServingTelemetry`` hooks below at block granularity, the
+registry exports Prometheus text / JSON (``serve/api.py`` ``/metrics``,
+``ffsv_metrics_dump`` in the C ABI), and the tracer writes a
+Perfetto-loadable JSONL span trace per request.
+
+Disabled by default. ``enable_telemetry()`` installs a process-global
+``ServingTelemetry``; every instrumentation site resolves
+``get_telemetry()`` once per host-loop iteration and skips ALL work on
+None — the disabled decode round pays one attribute read, nothing else
+(tests/test_telemetry.py pins zero events recorded when disabled).
+
+Metric vocabulary (all ``ffsv_`` — the serving ABI prefix):
+
+===============================  =========  =================================
+name                             kind       meaning
+===============================  =========  =================================
+ffsv_requests_total              counter    requests admitted
+ffsv_requests_finished_total     counter    requests completed
+ffsv_tokens_generated_total      counter    output tokens committed
+ffsv_prefill_tokens_total        counter    prompt tokens prefilled
+ffsv_spec_rounds_total           counter    speculation rounds executed
+ffsv_decode_steps_total          counter    incremental decode steps
+ffsv_acceptance_length           histogram  accepted draft tokens per round
+ffsv_tokens_per_round            histogram  committed tokens per round (+bonus)
+ffsv_batch_occupancy             histogram  live slots / max slots per tick
+ffsv_kv_cache_utilization        histogram  mean seq_len / max_seq over live
+ffsv_prefill_queue_depth         gauge      pending (unadmitted) requests
+ffsv_prefill_step_seconds        histogram  device-fenced prefill step time
+ffsv_decode_block_seconds        histogram  device-fenced decode block time
+ffsv_spec_block_seconds          histogram  device-fenced speculation block
+ffsv_request_latency_seconds     histogram  admission -> finish
+ffsv_request_ttft_seconds        histogram  admission -> first token
+ffsv_per_token_latency_seconds   histogram  latency / output tokens
+ffsv_draft_depth                 gauge      current speculation chain depth
+ffsv_tree_width                  gauge      verify-pass token-tree width
+===============================  =========  =================================
+
+Timing honesty: block/step timings are recorded by the serving loop
+AROUND device calls whose results are read back to the host
+(``np.asarray`` of the packed block output, or an explicit
+``utils/profiling.device_fence`` on the donated op_state for
+output-free prefill steps) — ``jax.block_until_ready`` is not a fence
+through the axon tunnel (utils/profiling.py protocol).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from flexflow_tpu.telemetry.metrics import (
+    COUNT_BUCKETS,
+    DEFAULT_TIME_BUCKETS,
+    FRACTION_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsHTTPServer,
+    MetricsRegistry,
+    percentile,
+)
+from flexflow_tpu.telemetry.tracing import SpanTracer, load_jsonl
+
+
+class ServingTelemetry:
+    """One registry + tracer pair with the serving hook vocabulary.
+
+    The hook methods keep every instrumentation site in the serving
+    stack to one guarded line; they are the only place metric names are
+    spelled, so the table in the module docstring stays the schema."""
+
+    def __init__(self, trace_path: Optional[str] = None):
+        self.registry = MetricsRegistry()
+        self.tracer = SpanTracer(trace_path)
+        r = self.registry
+        self.requests_total = r.counter(
+            "ffsv_requests_total", "requests admitted")
+        self.requests_finished = r.counter(
+            "ffsv_requests_finished_total", "requests completed")
+        self.tokens_generated = r.counter(
+            "ffsv_tokens_generated_total", "output tokens committed")
+        self.prefill_tokens = r.counter(
+            "ffsv_prefill_tokens_total", "prompt tokens prefilled")
+        self.spec_rounds = r.counter(
+            "ffsv_spec_rounds_total", "speculation rounds executed")
+        self.decode_steps = r.counter(
+            "ffsv_decode_steps_total", "incremental decode steps")
+        self.acceptance_length = r.histogram(
+            "ffsv_acceptance_length",
+            "accepted draft tokens per speculation round",
+            buckets=COUNT_BUCKETS)
+        self.tokens_per_round = r.histogram(
+            "ffsv_tokens_per_round",
+            "committed tokens per round (accepted + bonus)",
+            buckets=COUNT_BUCKETS)
+        self.batch_occupancy = r.histogram(
+            "ffsv_batch_occupancy", "live slots / max slots per host tick",
+            buckets=FRACTION_BUCKETS)
+        self.kv_utilization = r.histogram(
+            "ffsv_kv_cache_utilization",
+            "mean sequence length / max_seq over live requests",
+            buckets=FRACTION_BUCKETS)
+        self.queue_depth = r.gauge(
+            "ffsv_prefill_queue_depth", "pending (unadmitted) requests")
+        self.prefill_seconds = r.histogram(
+            "ffsv_prefill_step_seconds", "device-fenced prefill step time")
+        self.decode_block_seconds = r.histogram(
+            "ffsv_decode_block_seconds",
+            "device-fenced fused decode block time")
+        self.spec_block_seconds = r.histogram(
+            "ffsv_spec_block_seconds",
+            "device-fenced fused speculation block time")
+        self.request_latency = r.histogram(
+            "ffsv_request_latency_seconds", "admission -> finish")
+        self.request_ttft = r.histogram(
+            "ffsv_request_ttft_seconds", "admission -> first token")
+        self.per_token_latency = r.histogram(
+            "ffsv_per_token_latency_seconds",
+            "request latency / output tokens")
+        self.draft_depth = r.gauge(
+            "ffsv_draft_depth", "current speculation chain depth")
+        self.tree_width = r.gauge(
+            "ffsv_tree_width", "verify-pass token-tree width")
+
+    # -- hooks (serve/request_manager.py, serve/engine.py) ---------------
+    def note_admission(self, guid: int, prompt_tokens: int,
+                       max_new_tokens: int):
+        self.requests_total.inc()
+        self.tracer.admission(guid, prompt_tokens, max_new_tokens)
+
+    def note_batch(self, pending: int, live: int, slots: int,
+                   kv_fraction: Optional[float]):
+        """Once per host scheduling tick that dispatched device work."""
+        self.queue_depth.set(pending)
+        self.batch_occupancy.observe(live / max(1, slots))
+        if kv_fraction is not None:
+            self.kv_utilization.observe(kv_fraction)
+
+    def record_prefill(self, seconds: float, n_tokens: int, rows=()):
+        self.prefill_seconds.observe(seconds)
+        self.prefill_tokens.inc(n_tokens)
+        t0 = time.perf_counter() - seconds
+        for guid, start_pos, n in rows:
+            self.tracer.prefill(guid, start_pos, n, t0, seconds)
+
+    def record_decode_block(self, seconds: float, steps: int, n_live: int,
+                            guids=()):
+        self.decode_block_seconds.observe(seconds)
+        self.decode_steps.inc(steps * n_live)
+        t0 = time.perf_counter() - seconds
+        for g in guids:
+            self.tracer.decode_block(g, steps, t0, seconds)
+
+    def record_spec_block(self, seconds: float, n_acc: np.ndarray,
+                          depth: int, tree_width: int):
+        """After one fused speculation block (all engines): ``n_acc`` is
+        the packed [R, rounds] accepted-length matrix, -1 marking idle
+        rounds. Called from engine.run_block, so bench/direct engine
+        drivers are instrumented too, not just the RequestManager."""
+        self.spec_block_seconds.observe(seconds)
+        self.draft_depth.set(depth)
+        self.tree_width.set(tree_width)
+        valid = np.asarray(n_acc).ravel()
+        valid = valid[valid >= 0]
+        self.spec_rounds.inc(int(valid.size))
+        self.acceptance_length.observe_many(valid.tolist())
+        self.tokens_per_round.observe_many((valid + 1).tolist())
+
+    def trace_rounds(self, guid: int, committed_per_round, block_t0: float,
+                     block_dur: float, rounds_in_block: int):
+        """Per-request round events reconciled from a fused block;
+        ``committed_per_round`` is [(round_idx, n_accepted, committed)]."""
+        for k, n, c in committed_per_round:
+            self.tracer.decode_round(guid, k, n, c, block_t0, block_dur,
+                                     rounds_in_block)
+
+    def note_finish(self, guid: int, output_tokens: int, latency_s: float,
+                    ttft_s: float):
+        self.requests_finished.inc()
+        self.tokens_generated.inc(output_tokens)
+        if latency_s > 0:
+            self.request_latency.observe(latency_s)
+            self.per_token_latency.observe(
+                latency_s / max(1, output_tokens))
+        if ttft_s > 0:
+            self.request_ttft.observe(ttft_s)
+        self.tracer.finish(guid, output_tokens, latency_s, ttft_s)
+
+    def close(self):
+        self.tracer.close()
+
+
+# ---------------------------------------------------------------------------
+# process-global switch (resolved per host-loop iteration, never cached
+# across loops, so enabling mid-session takes effect at the next batch)
+# ---------------------------------------------------------------------------
+
+_telemetry: Optional[ServingTelemetry] = None
+
+
+def enable_telemetry(trace_path: Optional[str] = None) -> ServingTelemetry:
+    """Install (or replace) the global ServingTelemetry and return it."""
+    global _telemetry
+    if _telemetry is not None:
+        _telemetry.close()
+    _telemetry = ServingTelemetry(trace_path)
+    return _telemetry
+
+
+def disable_telemetry():
+    global _telemetry
+    if _telemetry is not None:
+        _telemetry.close()
+    _telemetry = None
+
+
+def get_telemetry() -> Optional[ServingTelemetry]:
+    return _telemetry
+
+
+def ensure_telemetry(trace_path: Optional[str] = None) -> ServingTelemetry:
+    """Enable the global telemetry if absent, otherwise keep the live
+    instance (its registry survives) and attach ``trace_path`` to its
+    tracer — warning, not silently dropping, if the tracer is already
+    writing a DIFFERENT file. The one bootstrap used by LLM.compile,
+    start_metrics_server, and the C-ABI host."""
+    tel = get_telemetry()
+    if tel is None:
+        return enable_telemetry(trace_path)
+    if trace_path and not tel.tracer.attach_file(trace_path):
+        import warnings
+
+        warnings.warn(
+            f"telemetry trace path {trace_path!r} ignored: telemetry is "
+            f"already tracing to {tel.tracer.path!r}", stacklevel=2)
+    return tel
+
+
+__all__ = [
+    "COUNT_BUCKETS",
+    "DEFAULT_TIME_BUCKETS",
+    "FRACTION_BUCKETS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsHTTPServer",
+    "MetricsRegistry",
+    "ServingTelemetry",
+    "SpanTracer",
+    "disable_telemetry",
+    "enable_telemetry",
+    "ensure_telemetry",
+    "get_telemetry",
+    "load_jsonl",
+    "percentile",
+]

@@ -1,0 +1,319 @@
+"""Serving metrics: counters, gauges, histograms + Prometheus/JSON export.
+
+The reference ships two profiling layers (per-kernel ``--profiling``
+timing and Legion Prof traces — SURVEY §5) but records nothing about the
+SERVING runtime: acceptance rates, batch occupancy and per-request
+latency are computed transiently inside the RequestManager loops and
+thrown away. This module is the persistent half of that story: a
+dependency-free registry of instruments whose snapshot exports as
+Prometheus text (the ``/metrics`` endpoint, serve/api.py) or JSON (the
+``ffsv_metrics_dump`` C-ABI entry, native/src/serve_c.cpp).
+
+Overhead contract: the serving hot loop is the host side of fused device
+blocks (one dispatch per ~decode_block_steps tokens), so instrument
+updates happen at block granularity, not token granularity. All mutation
+is plain attribute/list append — GIL-atomic, no locks — and the serving
+thread is the single writer (readers snapshot; a torn read across
+``_sum``/``_n`` costs one sample of skew, never a crash). When telemetry
+is disabled nothing in this module is ever imported on the decode path.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# Prometheus-style default latency buckets (seconds), wide enough for
+# both a single fused decode step (~ms) and whole-request latency (~min).
+DEFAULT_TIME_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+# Fractions (occupancy, utilization).
+FRACTION_BUCKETS = tuple(i / 10 for i in range(1, 11))
+# Small-integer buckets (acceptance lengths, tokens/round) — upper bounds
+# cover the reference's MAX_BEAM_DEPTH=8 envelope plus the bonus token.
+COUNT_BUCKETS = tuple(float(i) for i in range(0, 17))
+
+
+def percentile(sorted_values: Sequence[float], q: float) -> float:
+    """Linear-interpolated percentile of an ASCENDING-sorted sequence
+    (q in [0, 100]). Returns nan on empty input."""
+    n = len(sorted_values)
+    if n == 0:
+        return float("nan")
+    if n == 1:
+        return float(sorted_values[0])
+    pos = (q / 100.0) * (n - 1)
+    lo = int(math.floor(pos))
+    hi = min(lo + 1, n - 1)
+    frac = pos - lo
+    return float(sorted_values[lo] * (1 - frac) + sorted_values[hi] * frac)
+
+
+class Counter:
+    """Monotonic counter."""
+
+    __slots__ = ("name", "help", "_value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0):
+        self._value += n
+
+    def reset(self):
+        self._value = 0.0
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def snapshot(self) -> dict:
+        return {"type": "counter", "value": self._value}
+
+
+class Gauge:
+    """Last-write-wins instantaneous value."""
+
+    __slots__ = ("name", "help", "_value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+
+    def set(self, v: float):
+        self._value = float(v)
+
+    def inc(self, n: float = 1.0):
+        self._value += n
+
+    def reset(self):
+        self._value = 0.0
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def snapshot(self) -> dict:
+        return {"type": "gauge", "value": self._value}
+
+
+class Histogram:
+    """Bucketed histogram that ALSO retains raw samples for exact
+    percentiles.
+
+    Prometheus histograms are cumulative-bucket-only, which quantizes
+    p99 to a bucket edge; serving telemetry wants exact tail latency, so
+    observations append to a bounded ring (``sample_cap``, default 64k)
+    and ``percentile(q)`` sorts the retained window. Export emits both
+    forms: cumulative ``_bucket`` lines for Prometheus scrapers and a
+    ``percentiles`` block in the JSON snapshot.
+    """
+
+    __slots__ = ("name", "help", "buckets", "_counts", "_sum", "_n",
+                 "_samples", "_cap", "_next")
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Sequence[float] = DEFAULT_TIME_BUCKETS,
+                 sample_cap: int = 65536):
+        self.name = name
+        self.help = help
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)   # +Inf tail
+        self._sum = 0.0
+        self._n = 0
+        self._samples: List[float] = []
+        self._cap = int(sample_cap)
+        self._next = 0                                  # ring write cursor
+
+    def observe(self, v: float):
+        v = float(v)
+        # linear scan beats bisect for the short bucket lists used here
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                self._counts[i] += 1
+                break
+        else:
+            self._counts[-1] += 1
+        self._sum += v
+        self._n += 1
+        if len(self._samples) < self._cap:
+            self._samples.append(v)
+        else:                       # ring overwrite keeps a recent window
+            self._samples[self._next] = v
+            self._next = (self._next + 1) % self._cap
+
+    def observe_many(self, values):
+        for v in values:
+            self.observe(v)
+
+    def reset(self):
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._n = 0
+        self._samples = []
+        self._next = 0
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def percentile(self, q: float) -> float:
+        return percentile(sorted(self._samples), q)
+
+    def snapshot(self) -> dict:
+        srt = sorted(self._samples)
+        cum, counts = 0, []
+        for c in self._counts:
+            cum += c
+            counts.append(cum)
+        return {
+            "type": "histogram",
+            "count": self._n,
+            "sum": self._sum,
+            "buckets": [[b, c] for b, c in zip(self.buckets, counts)]
+            + [["+Inf", counts[-1]]],
+            "percentiles": {
+                "p50": percentile(srt, 50),
+                "p90": percentile(srt, 90),
+                "p99": percentile(srt, 99),
+            } if srt else {},
+        }
+
+
+class MetricsRegistry:
+    """Name -> instrument map with get-or-create accessors.
+
+    ``counter``/``gauge``/``histogram`` return the existing instrument
+    when the name is already registered (mismatched kinds raise), so
+    instrumentation sites never need to coordinate creation order.
+    """
+
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+
+    def _get_or_create(self, cls, name, help, **kw):
+        m = self._metrics.get(name)
+        if m is None:
+            m = self._metrics[name] = cls(name, help, **kw)
+        elif not isinstance(m, cls):
+            raise TypeError(f"metric {name!r} already registered as "
+                            f"{type(m).__name__}, not {cls.__name__}")
+        return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = DEFAULT_TIME_BUCKETS
+                  ) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def reset(self):
+        """Zero every instrument IN PLACE (for callers separating timed
+        passes). Instruments stay registered, so cached references —
+        ServingTelemetry holds its hooks' instruments as attributes —
+        keep feeding the same registry after the reset."""
+        for m in self._metrics.values():
+            m.reset()
+
+    # -- export -----------------------------------------------------------
+    def snapshot(self) -> Dict[str, dict]:
+        return {name: m.snapshot() for name, m in sorted(self._metrics.items())}
+
+    def to_json(self, indent: Optional[int] = None) -> str:
+        return json.dumps(self.snapshot(), indent=indent)
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format v0.0.4."""
+        lines: List[str] = []
+        for name, m in sorted(self._metrics.items()):
+            if m.help:
+                lines.append(f"# HELP {name} {m.help}")
+            if isinstance(m, Counter):
+                lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {_fmt(m.value)}")
+            elif isinstance(m, Gauge):
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {_fmt(m.value)}")
+            elif isinstance(m, Histogram):
+                lines.append(f"# TYPE {name} histogram")
+                cum = 0
+                for b, c in zip(m.buckets, m._counts):
+                    cum += c
+                    lines.append(f'{name}_bucket{{le="{_fmt(b)}"}} {cum}')
+                cum += m._counts[-1]
+                lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+                lines.append(f"{name}_sum {_fmt(m.sum)}")
+                lines.append(f"{name}_count {m.count}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+# ---------------------------------------------------------------------------
+# /metrics HTTP endpoint (serve/api.py LLM.start_metrics_server)
+# ---------------------------------------------------------------------------
+
+class MetricsHTTPServer:
+    """Minimal scrape endpoint: ``GET /metrics`` (Prometheus text),
+    ``GET /metrics.json`` (JSON snapshot). Daemon thread, stdlib-only.
+    ``port=0`` binds an ephemeral port (``.port`` holds the real one)."""
+
+    def __init__(self, registry_fn, host: str = "127.0.0.1", port: int = 0):
+        import http.server
+        import threading
+
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                reg = outer._registry_fn()
+                if self.path.startswith("/metrics.json"):
+                    body = (reg.to_json() if reg else "{}").encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/metrics"):
+                    body = (reg.to_prometheus() if reg else "").encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):          # no stderr chatter
+                pass
+
+        self._registry_fn = registry_fn
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name="flexflow-metrics")
+        self._thread.start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join()

@@ -1,0 +1,170 @@
+"""Per-request span tracing: structured JSONL events, Perfetto-loadable.
+
+Every serving request emits a span sequence — admission -> prefill
+chunk(s) -> decode/speculation rounds -> finish — as Chrome Trace Event
+Format objects, one JSON object per line (JSONL). Each event carries the
+request guid as its ``tid``, so Perfetto renders one track per request;
+``pid`` 1 is the serving process. ``export_chrome_trace`` wraps the
+buffered events into a ``{"traceEvents": [...]}`` file that Perfetto /
+chrome://tracing load directly (the raw JSONL is for programmatic
+consumption: one ``json.loads`` per line).
+
+Correlation with device traces: the first event is a ``clock_sync``
+metadata record holding both ``time.time()`` (wall clock) and the
+``perf_counter`` origin all span timestamps are relative to. A
+``jax.profiler`` trace taken around the same run
+(``utils/profiling.profiler_trace``) timestamps its XLA events on the
+same wall clock, so the recipe is: load both files in Perfetto and align
+on the wall-clock epoch (README "Telemetry" section). Span events also
+carry the guid in ``args`` so a device-trace step can be matched to the
+request(s) it served.
+
+Round-granularity caveat: speculation/decode rounds execute INSIDE one
+fused device program (serve/engine.py), so the host only observes the
+block's fenced wall time plus per-round acceptance counts after the
+fact. Round events are therefore reconstructed with the block duration
+divided evenly across its rounds — per-round ordering and counts are
+exact, per-round timestamps are block-granular estimates.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import IO, List, Optional
+
+
+class SpanTracer:
+    """Buffers trace events; optionally appends them to a JSONL file.
+
+    The in-memory buffer is a RING of the most recent ``max_events``
+    (default 64k) so a long-lived serving process cannot grow without
+    bound — the JSONL file, when a path is given, still receives every
+    event. The ``clock_sync`` epoch record is kept outside the ring so
+    exports stay alignable however much history has rotated out.
+    """
+
+    FLUSH_EVERY = 128
+
+    def __init__(self, path: Optional[str] = None, max_events: int = 65536):
+        from collections import deque
+
+        self.path = path
+        self._ring = deque(maxlen=max(1, int(max_events)))
+        self._sync: Optional[dict] = None
+        self._file: Optional[IO[str]] = None
+        self._n_written = 0
+        self._t0 = time.perf_counter()
+        if path:
+            self._file = open(path, "w")
+        self.emit("clock_sync", "M", ts_s=self._t0,
+                  wall_time_s=time.time(), perf_counter_origin=self._t0)
+
+    @property
+    def events(self) -> List[dict]:
+        """clock_sync + the retained (most recent) event window."""
+        return ([self._sync] if self._sync else []) + list(self._ring)
+
+    def attach_file(self, path: str) -> bool:
+        """Start writing JSONL to ``path`` on an already-live tracer,
+        seeding it with the retained event window. Re-attaching the
+        SAME path is a no-op success; returns False (and does nothing)
+        only if a DIFFERENT trace file is already attached."""
+        if self._file is not None:
+            return self.path == path
+        self.path = path
+        self._file = open(path, "w")
+        for ev in self.events:
+            self._file.write(json.dumps(ev) + "\n")
+        self._file.flush()
+        return True
+
+    # -- core -------------------------------------------------------------
+    def _us(self, t: Optional[float]) -> float:
+        return ((time.perf_counter() if t is None else t) - self._t0) * 1e6
+
+    def emit(self, name: str, ph: str, guid: Optional[int] = None,
+             ts_s: Optional[float] = None, dur_s: Optional[float] = None,
+             **args):
+        """Record one Trace Event Format object. ``ph``: "X" complete
+        span (needs dur_s), "i" instant, "M" metadata. ``ts_s``/``dur_s``
+        are perf_counter-based seconds; ts defaults to now."""
+        ev = {"name": name, "ph": ph, "pid": 1,
+              "tid": int(guid) if guid is not None else 0,
+              "ts": round(self._us(ts_s), 1)}
+        if dur_s is not None:
+            ev["dur"] = round(dur_s * 1e6, 1)
+        if ph == "i":
+            ev["s"] = "t"            # thread-scoped instant
+        if args:
+            ev["args"] = args
+        if ev["name"] == "clock_sync":
+            self._sync = ev
+        else:
+            self._ring.append(ev)
+        if self._file is not None:
+            # buffered write; flushed every FLUSH_EVERY events and on
+            # close()/flush() — a per-event fsync-style flush would put
+            # syscall pairs inside the serving host loop
+            self._file.write(json.dumps(ev) + "\n")
+            self._n_written += 1
+            if self._n_written % self.FLUSH_EVERY == 0:
+                self._file.flush()
+
+    # -- span vocabulary (the JSONL schema documented in README) ----------
+    def admission(self, guid: int, prompt_tokens: int, max_new_tokens: int):
+        self.emit("admission", "i", guid, request_guid=guid,
+                  prompt_tokens=prompt_tokens,
+                  max_new_tokens=max_new_tokens)
+
+    def prefill(self, guid: int, start_pos: int, n_tokens: int,
+                ts_s: float, dur_s: float):
+        self.emit("prefill", "X", guid, ts_s=ts_s, dur_s=dur_s,
+                  request_guid=guid,
+                  start_pos=start_pos, n_tokens=n_tokens)
+
+    def decode_block(self, guid: int, steps: int, ts_s: float,
+                     dur_s: float):
+        self.emit("decode_block", "X", guid, ts_s=ts_s, dur_s=dur_s,
+                  request_guid=guid, steps=steps)
+
+    def decode_round(self, guid: int, round_idx: int, n_accepted: int,
+                     committed: int, block_t0: float, block_dur: float,
+                     rounds_in_block: int):
+        """One speculation round, reconstructed from a fused block (see
+        module docstring for the timestamp caveat)."""
+        per = block_dur / max(1, rounds_in_block)
+        self.emit("decode_round", "X", guid,
+                  ts_s=block_t0 + round_idx * per, dur_s=per,
+                  request_guid=guid,
+                  round=round_idx, n_accepted=n_accepted,
+                  committed_tokens=committed)
+
+    def finish(self, guid: int, output_tokens: int, latency_s: float,
+               ttft_s: float):
+        self.emit("finish", "i", guid, request_guid=guid,
+                  output_tokens=output_tokens,
+                  latency_s=round(latency_s, 6),
+                  ttft_s=round(ttft_s, 6))
+
+    # -- output -----------------------------------------------------------
+    def export_chrome_trace(self, path: str):
+        """Write the buffered events as one Perfetto-loadable JSON file."""
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self.events,
+                       "displayTimeUnit": "ms"}, f)
+
+    def flush(self):
+        if self._file is not None:
+            self._file.flush()
+
+    def close(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+def load_jsonl(path: str) -> List[dict]:
+    """Parse a JSONL trace back into event dicts (test/analysis helper)."""
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]

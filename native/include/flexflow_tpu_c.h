@@ -229,6 +229,16 @@ long ffsv_register_request_text(void *llm, const char *text,
                                 int max_new_tokens);
 char *ffsv_get_output_text(void *llm, long guid);
 
+/* Snapshot the serving telemetry registry (flexflow_tpu/telemetry):
+ * acceptance/latency histograms, batch occupancy, per-round counters.
+ * format: "json" (structured, incl. exact p50/p90/p99 per histogram) or
+ * "prometheus" (text exposition). Enable by setting the config field
+ * "telemetry" to "true" before ffsv_llm_create (optionally
+ * "telemetry_trace_path" for the JSONL span trace); disabled telemetry
+ * dumps an empty snapshot ("{}" / ""). Returns a malloc'd string the
+ * caller frees, or NULL on error (see ffsv_last_error). */
+char *ffsv_metrics_dump(const char *format);
+
 #ifdef __cplusplus
 }
 #endif

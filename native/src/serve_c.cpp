@@ -103,6 +103,15 @@ int ffsv_init(const char *repo_root) {
     set_error_from_python();
     return -1;
   }
+  /* Embedded-host-only setup (JAX_PLATFORMS override) runs HERE, not at
+   * module import: ordinary Python importers of capi_host must not have
+   * their session's backend mutated as a side effect. */
+  PyObject *r = call("host_init", nullptr);
+  if (!r) {
+    Py_CLEAR(g_host);
+    return -1;
+  }
+  Py_DECREF(r);
   return 0;
 }
 
@@ -114,9 +123,27 @@ void *ffsv_config_create(void) { return call("config_create", nullptr); }
 
 /* Reference flexflow_config_parse_args: argv of reference-style flags. */
 void *ffsv_config_parse_args(int argc, const char **argv) {
+  if (!g_host) {
+    g_error = "ffsv_init not called";
+    return nullptr;
+  }
   PyObject *lst = PyList_New(argc);
-  for (int i = 0; i < argc; i++)
-    PyList_SetItem(lst, i, PyUnicode_FromString(argv[i]));
+  if (!lst) {
+    set_error_from_python();
+    return nullptr;
+  }
+  for (int i = 0; i < argc; i++) {
+    PyObject *s = PyUnicode_FromString(argv[i]);
+    if (!s) {
+      /* non-UTF-8 argv: a NULL element would make the later tuple
+       * conversion/call segfault the embedding host — fail loudly with
+       * ffsv_last_error set instead (ADVICE r5) */
+      set_error_from_python();
+      Py_DECREF(lst);
+      return nullptr;
+    }
+    PyList_SetItem(lst, i, s);
+  }
   return call("config_parse_args", Py_BuildValue("(N)", lst));
 }
 
@@ -226,6 +253,20 @@ long ffsv_register_request_text(void *llm, const char *text,
 char *ffsv_get_output_text(void *llm, long guid) {
   PyObject *r = call("get_output_text",
                      Py_BuildValue("(Ol)", (PyObject *)llm, guid));
+  if (!r) return nullptr;
+  const char *c = PyUnicode_AsUTF8(r);
+  char *out = c ? strdup(c) : nullptr;
+  Py_DECREF(r);
+  return out;
+}
+
+/* Snapshot the serving telemetry registry ("json" or "prometheus");
+ * malloc'd string the caller frees, or NULL on error. Empty snapshot
+ * ("{}" / "") when telemetry is disabled — enable via
+ * ffsv_config_set(cfg, "telemetry", "true") before ffsv_llm_create. */
+char *ffsv_metrics_dump(const char *format) {
+  PyObject *r = call("metrics_dump",
+                     Py_BuildValue("(s)", format ? format : "json"));
   if (!r) return nullptr;
   const char *c = PyUnicode_AsUTF8(r);
   char *out = c ? strdup(c) : nullptr;

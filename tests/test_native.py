@@ -557,6 +557,43 @@ def test_ffsv_serving_abi_in_process():
     assert n2 >= 4
     lib.ffsv_release(pair)
 
+    # telemetry surface (ffsv_metrics_dump): disabled -> empty snapshot;
+    # enabled -> the generate above the dump shows up in the registry
+    # (in-process, so the Python side can flip the global switch without
+    # building another model through the C path)
+    import json as _mjson
+
+    from flexflow_tpu.telemetry import disable_telemetry, enable_telemetry
+
+    lib.ffsv_metrics_dump.restype = c.c_void_p
+    lib.ffsv_metrics_dump.argtypes = [c.c_char_p]
+    libc_m = ctypes.CDLL(None)
+    libc_m.free.argtypes = [ctypes.c_void_p]
+    ptr = lib.ffsv_metrics_dump(b"json")
+    assert ptr, lib.ffsv_last_error()
+    assert ctypes.string_at(ptr) == b"{}"
+    libc_m.free(ptr)
+    enable_telemetry()
+    try:
+        prompt3 = (c.c_int32 * 3)(5, 9, 23)
+        g3 = lib.ffsv_register_request(llm, prompt3, 3, 2)
+        assert g3 >= 0 and lib.ffsv_generate(llm) == 1, lib.ffsv_last_error()
+        ptr = lib.ffsv_metrics_dump(b"prometheus")
+        assert ptr, lib.ffsv_last_error()
+        prom = ctypes.string_at(ptr).decode()
+        libc_m.free(ptr)
+        assert "ffsv_requests_total 1" in prom
+        ptr = lib.ffsv_metrics_dump(b"json")
+        assert ptr, lib.ffsv_last_error()
+        snap = _mjson.loads(ctypes.string_at(ptr).decode())
+        libc_m.free(ptr)
+        assert snap["ffsv_tokens_generated_total"]["value"] == 2
+        # unknown format: NULL with ffsv_last_error set, not a crash
+        assert not lib.ffsv_metrics_dump(b"bogus")
+        assert b"metrics format" in lib.ffsv_last_error()
+    finally:
+        disable_telemetry()
+
     # text surface (reference flexflow_model_generate takes TEXT): a
     # toy byte-level vocab round-trips prompt -> tokens -> text
     import json as _json

@@ -1,0 +1,216 @@
+"""Telemetry subsystem tests (lean: one tiny spec pair shared across the
+serving tests — the tier-1 budget is saturated, so geometry matches the
+proven TINY config from test_serving and generation lengths stay small).
+
+Covers: counter/histogram math + exact percentiles, Prometheus/JSON
+export format, span lifecycle + JSONL/Chrome trace output, the /metrics
+HTTP endpoint, a 2-round speculative decode recording the expected
+acceptance-length events, and the disabled path recording nothing.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.ffconst import InferenceMode
+from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+from flexflow_tpu.serve.request_manager import RequestManager
+from flexflow_tpu.telemetry import (MetricsHTTPServer, MetricsRegistry,
+                                    SpanTracer, disable_telemetry,
+                                    enable_telemetry, get_telemetry,
+                                    load_jsonl)
+
+TINY = LLAMAConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                   num_hidden_layers=2, num_attention_heads=4,
+                   num_key_value_heads=2, max_position_embeddings=128)
+
+
+# ---------------------------------------------------------------------------
+# instrument math + export (no models)
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_histogram_math():
+    reg = MetricsRegistry()
+    c = reg.counter("reqs", "requests")
+    c.inc()
+    c.inc(2)
+    assert c.value == 3
+    assert reg.counter("reqs") is c        # get-or-create returns existing
+    g = reg.gauge("depth")
+    g.set(7)
+    assert g.value == 7
+    h = reg.histogram("lat", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    assert h.count == 4 and h.sum == pytest.approx(55.55)
+    snap = h.snapshot()
+    # cumulative bucket counts: <=0.1:1, <=1:2, <=10:3, +Inf:4
+    assert snap["buckets"] == [[0.1, 1], [1.0, 2], [10.0, 3], ["+Inf", 4]]
+    # exact percentiles over retained samples (1..100 -> p50=50.5, p99=99.01)
+    h2 = reg.histogram("pct", buckets=(1e9,))
+    h2.observe_many(range(1, 101))
+    assert h2.percentile(50) == pytest.approx(50.5)
+    assert h2.percentile(99) == pytest.approx(99.01)
+    with pytest.raises(TypeError):
+        reg.counter("lat")                 # kind mismatch must raise
+
+
+def test_prometheus_and_json_export():
+    reg = MetricsRegistry()
+    reg.counter("ffsv_requests_total", "requests admitted").inc(3)
+    h = reg.histogram("ffsv_step_seconds", "step time", buckets=(0.01, 0.1))
+    h.observe(0.005)
+    h.observe(0.5)
+    text = reg.to_prometheus()
+    assert "# TYPE ffsv_requests_total counter" in text
+    assert "ffsv_requests_total 3" in text
+    assert "# TYPE ffsv_step_seconds histogram" in text
+    assert 'ffsv_step_seconds_bucket{le="0.01"} 1' in text
+    assert 'ffsv_step_seconds_bucket{le="+Inf"} 2' in text
+    assert "ffsv_step_seconds_count 2" in text
+    snap = json.loads(reg.to_json())
+    assert snap["ffsv_requests_total"] == {"type": "counter", "value": 3}
+    assert snap["ffsv_step_seconds"]["count"] == 2
+    assert snap["ffsv_step_seconds"]["percentiles"]["p50"] > 0
+
+
+def test_span_tracer_lifecycle(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    tr = SpanTracer(path)
+    tr.admission(42, prompt_tokens=4, max_new_tokens=8)
+    t0 = tr._t0
+    tr.prefill(42, start_pos=0, n_tokens=3, ts_s=t0 + 0.001, dur_s=0.002)
+    tr.decode_round(42, 0, n_accepted=2, committed=3, block_t0=t0 + 0.004,
+                    block_dur=0.01, rounds_in_block=2)
+    tr.finish(42, output_tokens=8, latency_s=0.02, ttft_s=0.005)
+    tr.close()
+    evs = load_jsonl(path)
+    assert [e["name"] for e in evs] == ["clock_sync", "admission",
+                                       "prefill", "decode_round", "finish"]
+    assert all(e["tid"] == 42 for e in evs[1:])  # one track per request
+    pre = evs[2]
+    assert pre["ph"] == "X" and pre["dur"] == pytest.approx(2000, abs=1)
+    assert pre["args"]["n_tokens"] == 3
+    rnd = evs[3]
+    assert rnd["args"]["n_accepted"] == 2
+    assert rnd["dur"] == pytest.approx(5000, abs=1)   # block_dur / rounds
+    # Perfetto/chrome form wraps the same events
+    chrome = str(tmp_path / "trace.json")
+    tr.export_chrome_trace(chrome)
+    doc = json.load(open(chrome))
+    assert [e["name"] for e in doc["traceEvents"]] == [e["name"] for e in evs]
+
+
+def test_metrics_http_endpoint():
+    reg = MetricsRegistry()
+    reg.counter("ffsv_requests_total").inc(5)
+    srv = MetricsHTTPServer(lambda: reg, port=0)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        text = urllib.request.urlopen(base + "/metrics").read().decode()
+        assert "ffsv_requests_total 5" in text
+        snap = json.loads(urllib.request.urlopen(
+            base + "/metrics.json").read().decode())
+        assert snap["ffsv_requests_total"]["value"] == 5
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# serving integration (one shared tiny spec pair)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def spec_pair():
+    def make(mode):
+        cfg = ff.FFConfig(max_requests_per_batch=2, max_sequence_length=64,
+                          max_tokens_per_batch=16, seed=0,
+                          kv_cache_dtype="float32")
+        m = ff.FFModel(cfg)
+        create_llama_model(m, TINY, mode=mode)
+        m.compile(comp_mode=ff.CompMode.COMP_MODE_INFERENCE)
+        return m
+
+    return (make(InferenceMode.TREE_VERIFY_MODE),
+            make(InferenceMode.BEAM_SEARCH_MODE))
+
+
+def test_spec_decode_records_expected_telemetry(spec_pair, tmp_path):
+    """A 2-round speculative decode (depth 2, same-weights draft -> full
+    acceptance, 3 tokens/round, 6-token budget) must produce the JSONL
+    span trace plus a metrics snapshot with the exact acceptance-length
+    events, per-round token counts, batch occupancy and p50/p99
+    per-token latency — the subsystem's acceptance criteria."""
+    llm, ssm = spec_pair
+    trace = str(tmp_path / "spec.jsonl")
+    tel = enable_telemetry(trace_path=trace)
+    try:
+        rm = RequestManager()
+        for p in [[5, 9, 23, 44], [7, 3, 11]]:
+            rm.register_new_request(p, max_new_tokens=6)
+        results = rm.generate_spec_infer(llm, [ssm], spec_depth=2)
+        assert sorted(len(r.output_tokens) for r in results) == [6, 6]
+
+        reg = tel.registry
+        # full acceptance at depth 2: each request commits 3 tokens/round
+        # for 2 rounds -> 4 round events, every accepted length == 2
+        acc = reg.get("ffsv_acceptance_length")
+        assert acc.count == 4 and acc.sum == 8
+        tpr = reg.get("ffsv_tokens_per_round")
+        assert tpr.count == 4 and tpr.sum == 12   # 3 committed per round
+        assert reg.get("ffsv_spec_rounds_total").value == 4
+        assert reg.get("ffsv_tokens_generated_total").value == 12
+        assert reg.get("ffsv_batch_occupancy").count > 0
+        assert reg.get("ffsv_batch_occupancy").percentile(50) == 1.0
+        assert reg.get("ffsv_kv_cache_utilization").count > 0
+        assert reg.get("ffsv_prefill_tokens_total").value == 10  # 5 x 2 models
+        assert reg.get("ffsv_spec_block_seconds").count >= 1
+        lat = reg.get("ffsv_per_token_latency_seconds")
+        assert lat.count == 2
+        assert 0 < lat.percentile(50) <= lat.percentile(99)
+        assert reg.get("ffsv_request_latency_seconds").count == 2
+        # exporters carry the same story
+        text = reg.to_prometheus()
+        assert "ffsv_acceptance_length_bucket" in text
+        assert "ffsv_requests_finished_total 2" in text
+    finally:
+        disable_telemetry()      # closes + flushes the JSONL trace file
+
+    # span trace: admission -> prefill -> decode rounds -> finish,
+    # one track (tid) per request guid
+    evs = load_jsonl(trace)
+    names = [e["name"] for e in evs]
+    assert names.count("admission") == 2 and names.count("finish") == 2
+    rounds = [e for e in evs if e["name"] == "decode_round"]
+    assert len(rounds) == 4
+    assert all(e["args"]["n_accepted"] == 2 for e in rounds)
+    guids = {r.guid for r in results}
+    assert {e["tid"] for e in rounds} == guids
+    assert any(e["name"] == "prefill" for e in evs)
+    # latency fields surfaced on the results themselves (serve/api.py)
+    assert all(r.latency_s > 0 and r.ttft_s > 0 for r in results)
+
+
+def test_disabled_path_records_no_events(spec_pair):
+    """With telemetry disabled the decode round must record NOTHING — no
+    global registry exists and a freshly enabled one afterwards is empty
+    (the zero-overhead guard for the disabled path)."""
+    llm, ssm = spec_pair
+    disable_telemetry()
+    assert get_telemetry() is None
+    rm = RequestManager()
+    rm.register_new_request([5, 9, 23, 44], max_new_tokens=6)
+    (res,) = rm.generate_spec_infer(llm, [ssm], spec_depth=2)
+    assert get_telemetry() is None          # nothing auto-enabled
+    assert len(res.output_tokens) == 6
+    assert res.latency_s > 0                # cheap always-on result fields
+    tel = enable_telemetry()
+    try:
+        snap = tel.registry.snapshot()      # fresh registry: all zeros
+        assert all(m.get("value", 0) == 0 and m.get("count", 0) == 0
+                   for m in snap.values())
+        assert len(tel.tracer.events) == 1  # clock_sync only
+    finally:
+        disable_telemetry()

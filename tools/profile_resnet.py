@@ -166,11 +166,12 @@ def make_step(nhwc, use_bn, fwd_only, mm1x1=False, bn_bf16acc=False):
 
 
 def run(name, nhwc, use_bn, fwd_only, flops_scale=1.0, mm1x1=False,
-        bn_bf16acc=False, donate=False):
+        bn_bf16acc=False, donate=False, reps=5):
     import jax
     import jax.numpy as jnp
 
     from flexflow_tpu.search.machine_model import TPU_CHIPS
+    from flexflow_tpu.telemetry.metrics import Histogram
 
     rng = np.random.default_rng(0)
     params, flops = init_params(rng, nhwc, mm1x1)
@@ -184,17 +185,33 @@ def run(name, nhwc, use_bn, fwd_only, flops_scale=1.0, mm1x1=False,
     loss, params = step(params, x, y)
     loss, params = step(params, x, y)
     float(loss)            # host readback: the only honest fence on axon
-    best = float("inf")
-    for _ in range(3):
+    # Per-rep spread, not just best-of (the driver's resnet MFU gate
+    # reads a MEDIAN over timing blocks — bench_train._mfu_report — so a
+    # wide rep distribution moves the gate without any code change;
+    # r5 record: driver median 0.251 vs the >= 0.27 target while the
+    # same build's best blocks sit at ~0.28). The telemetry histogram
+    # gives exact percentiles over the reps.
+    hist = Histogram(f"resnet_step_seconds[{name.strip()}]",
+                     "per-rep step wall time")
+    reps_s = []
+    for _ in range(reps):
         t0 = time.perf_counter()
         for _ in range(4):
             loss, params = step(params, x, y)
         float(loss)
-        best = min(best, (time.perf_counter() - t0) / 4)
+        reps_s.append((time.perf_counter() - t0) / 4)
+        hist.observe(reps_s[-1])
     flops *= flops_scale
     peak = TPU_CHIPS["v5e"].bf16_flops
+    reps_s.sort()
+    best, med, worst = reps_s[0], hist.percentile(50), reps_s[-1]
+    spread = (worst - best) / best if best > 0 else 0.0
     print(f"{name}: {best * 1e3:.2f} ms/step  "
           f"{flops / best / 1e12:.1f} TFLOP/s  MFU={flops / best / peak:.3f}")
+    print(f"{name}: rep spread {spread:.1%}  "
+          f"reps_ms={[round(t * 1e3, 2) for t in reps_s]}  "
+          f"MFU best/median/worst = {flops / best / peak:.3f}/"
+          f"{flops / med / peak:.3f}/{flops / worst / peak:.3f}")
 
 
 if __name__ == "__main__":
