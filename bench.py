@@ -340,6 +340,43 @@ class AcceptanceMeter:
         }
 
 
+def serving_load_section(llm, ssms, incr_tps: float) -> dict:
+    """Closed-loop load line (ROADMAP item 2's gate): a seeded Poisson
+    knee sweep through the background-server submission queue at offered
+    loads scaled off THIS round's measured incremental throughput, so the
+    sweep always brackets saturation whatever the hardware. Reports the
+    same SLO fields tools/loadtest.py prints; tools/bench_trend.py gates
+    peak throughput/goodput (and, loosely, the knee) round over round.
+    Deadlines are perf-relative (3x the per-request incremental service
+    time) so goodput measures scheduling quality, not absolute speed."""
+    from flexflow_tpu.serve.loadgen import (EngineHandle, TenantSpec,
+                                            WorkloadSpec, sweep)
+
+    n_step = NUM_REQUESTS
+    base_rps = max(incr_tps / NEW_TOKENS, 0.25)     # incr-sustainable req/s
+    deadline_s = 3.0 * NEW_TOKENS * NUM_REQUESTS / max(incr_tps, 1e-6)
+    spec = WorkloadSpec(
+        prompt_lens=(PROMPT_LEN // 2, PROMPT_LEN),
+        output_lens=(NEW_TOKENS // 2, NEW_TOKENS),
+        tenants=(TenantSpec("default", 1.0, deadline_s=deadline_s),),
+        vocab_size=VOCAB)
+    handle = EngineHandle(llm, ssms=ssms, spec_depth=SPEC_DEPTH)
+    try:
+        result = sweep(handle, spec,
+                       rates=[0.5 * base_rps, base_rps, 2.0 * base_rps],
+                       n_per_step=n_step, seed=0, process="poisson",
+                       p99_ttft_bound_s=deadline_s / 2,
+                       timeout_s=600.0)
+    finally:
+        handle.stop_server()
+    result["deadline_s"] = round(deadline_s, 3)
+    result["base_rps"] = round(base_rps, 3)
+    # round the per-step floats for a stable one-line JSON artifact
+    result["knee_rps"] = (round(result["knee_rps"], 3)
+                          if result["knee_rps"] is not None else None)
+    return result
+
+
 def _bf16_companion_line():
     """Run the bf16 1.3B-class geometry in a CHILD process and fold its
     headline into this run's JSON line (VERDICT r3 item 7: report a bf16
@@ -352,7 +389,8 @@ def _bf16_companion_line():
         # hard cap: a wedged child must not starve the int8 headline run
         # forward explicit tuning flags so the companion line measures the
         # same configuration the caller asked for
-        extra = []
+        extra = ["--no-load"]   # the parent's serving_load line is the
+        # gated artifact; a child load sweep would only burn tunnel time
         for flag in ("--draft-layers", "--spec-depth"):
             if flag in sys.argv:
                 extra += [flag, str(_arg_int(flag, 0))]
@@ -524,6 +562,21 @@ def main():
         return sum(incr_by_in[tuple(r.input_tokens)][:prefix]
                    == r.output_tokens[:prefix] for r in spec_res)
 
+    # closed-loop serving load line — BEFORE the acceptance-realism sweep
+    # below, which permanently rescales the verifier's deep layers (ends
+    # at eps=1.0, a fully-divergent draft); the load line must measure
+    # the same model the headline did. Never lose the headline to it;
+    # the bench_trend gate skips the section when absent and flags the
+    # drop the round AFTER it reappears.
+    serving_load = {}
+    if "--no-load" not in sys.argv:
+        try:
+            serving_load = with_retry(
+                lambda: serving_load_section(llm, ssms, incr_tps),
+                "serving load sweep")
+        except Exception as e:
+            serving_load = {"error": str(e)[:200]}
+
     # --- acceptance-realism sweep (VERDICT r4 weak-5/item 7): the
     # headline's tokens/round comes from ONE damping point (EPS); vary
     # the draft-verifier divergence by re-scaling the verifier's deep
@@ -607,6 +660,11 @@ def main():
         # measured acceptance — the rate the headline was achieved at
         **meter.stats(),
         **({"acceptance_sweep": sweep} if sweep else {}),
+        # closed-loop Poisson load: offered/achieved req/s, tokens/s,
+        # goodput, TTFT/latency p50/p99 and queue/service split per step,
+        # plus the saturation knee (serve/loadgen.py; gated round-over-
+        # round by tools/bench_trend.py)
+        **({"serving_load": serving_load} if serving_load else {}),
         # trace-time dispatch counts: how many attention ops COMPILED onto
         # each path (fused loops trace once however many steps execute)
         "attention_fast_path_traces": ffk.fast_path_count,

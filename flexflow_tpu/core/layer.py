@@ -26,6 +26,15 @@ class WeightSpec:
     initializer: Any = None        # Initializer or None -> op default
     # Sharding hint resolved at compile time, e.g. ("model", None) axis names
     sharding_dims: Optional[Tuple[Optional[str], ...]] = None
+    # Per-dim shard granularity: dim i may shard only if the per-device
+    # chunk is a multiple of shard_multiples[i] (None/1 = any). Attention
+    # projections set this to head_dim so TP splits at WHOLE-head
+    # boundaries — sub-head shards are useless to the attention kernel
+    # and rotate-half RoPE's half-dim slice+concat across a shard
+    # boundary miscompiles in the XLA SPMD partitioner (observed wrong
+    # numerics on CPU, jax 0.4.37: KH=2 @ tp=4 split each head across
+    # two devices and k's rotation came back scrambled).
+    shard_multiples: Optional[Tuple[Optional[int], ...]] = None
 
 
 class Layer:

@@ -822,7 +822,8 @@ class FFModel:
                 wdims = w.sharding_dims
                 if strat_op is not None and w.name in strat_op.weight_specs:
                     wdims = strat_op.weight_specs[w.name]
-                sharding = self.policy.weight_sharding(w.shape, wdims)
+                sharding = self.policy.weight_sharding(
+                    w.shape, wdims, w.shard_multiples)
                 lp[w.name] = jax.device_put(arr, sharding)
             if (self.config.quantization_type
                     and comp_mode == CompMode.COMP_MODE_INFERENCE):

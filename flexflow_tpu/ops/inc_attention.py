@@ -268,18 +268,30 @@ def _weight_specs(attrs, input_specs):
     H, KH, D = attrs["num_q_heads"], attrs["num_kv_heads"], attrs["head_dim"]
     dt = attrs.get("data_type") or d
     init = attrs.get("kernel_initializer") or default_kernel_initializer()
+    # TP splits projections at WHOLE-head boundaries only (shard_multiples
+    # = head_dim): the serving kernels consume [*, heads, D] blocks, and a
+    # sub-head split puts RoPE's rotate-half slice across a shard edge
+    # (wrong numerics out of the XLA SPMD partitioner — a KH that the TP
+    # degree doesn't divide now replicates wk/wv instead)
     specs = [
-        WeightSpec("wq", (E, H * D), dt, init, sharding_dims=(None, "model")),
-        WeightSpec("wk", (E, KH * D), dt, init, sharding_dims=(None, "model")),
-        WeightSpec("wv", (E, KH * D), dt, init, sharding_dims=(None, "model")),
-        WeightSpec("wo", (H * D, E), dt, init, sharding_dims=("model", None)),
+        WeightSpec("wq", (E, H * D), dt, init, sharding_dims=(None, "model"),
+                   shard_multiples=(None, D)),
+        WeightSpec("wk", (E, KH * D), dt, init, sharding_dims=(None, "model"),
+                   shard_multiples=(None, D)),
+        WeightSpec("wv", (E, KH * D), dt, init, sharding_dims=(None, "model"),
+                   shard_multiples=(None, D)),
+        WeightSpec("wo", (H * D, E), dt, init, sharding_dims=("model", None),
+                   shard_multiples=(D, None)),
     ]
     if attrs.get("bias", False):
         zero = ZeroInitializer()
         specs += [
-            WeightSpec("bq", (H * D,), dt, zero, sharding_dims=("model",)),
-            WeightSpec("bk", (KH * D,), dt, zero, sharding_dims=("model",)),
-            WeightSpec("bv", (KH * D,), dt, zero, sharding_dims=("model",)),
+            WeightSpec("bq", (H * D,), dt, zero, sharding_dims=("model",),
+                       shard_multiples=(D,)),
+            WeightSpec("bk", (KH * D,), dt, zero, sharding_dims=("model",),
+                       shard_multiples=(D,)),
+            WeightSpec("bv", (KH * D,), dt, zero, sharding_dims=("model",),
+                       shard_multiples=(D,)),
             WeightSpec("bo", (E,), dt, zero),
         ]
     return specs

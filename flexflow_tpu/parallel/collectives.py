@@ -31,10 +31,20 @@ def reduce_scatter(x, axis_name: str, *, scatter_dimension: int = 0):
                             tiled=True)
 
 
+def axis_size(axis_name: str) -> int:
+    """``lax.axis_size`` portable to jax 0.4.37, where the accessor does
+    not exist yet and the bound-axis size lives on ``lax.axis_index``'s
+    trace-time environment (``psum(1, axis)`` — constant-folded, never a
+    runtime collective)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return int(lax.psum(1, axis_name))
+
+
 def ppermute_shift(x, axis_name: str, shift: int = 1):
     """Ring shift: device i sends to (i+shift) mod n — the building block of
     ring attention / pipelined all-gather."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm)
 

@@ -26,6 +26,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from flexflow_tpu.parallel.collectives import axis_size
+from flexflow_tpu.utils.shard_map_compat import shard_map
+
 
 def _repeat_kv_heads(k, num_q_heads):
     """GQA: expand [b, s, kv_heads, d] to num_q_heads by repetition."""
@@ -44,7 +47,7 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
     (kv may carry fewer heads — GQA — they are repeated to match q).
     Returns [batch, s_local, heads, head_dim].
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, sq, h, d = q.shape
     k = _repeat_kv_heads(k, h)
@@ -118,5 +121,5 @@ def ring_attention(q, k, v, mesh: Mesh, seq_axis: str = "seq",
     spec = P(ba, seq_axis, None, None)
     fn = partial(ring_attention_local, axis_name=seq_axis, causal=causal,
                  scale=scale)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)

@@ -49,17 +49,27 @@ class ShardingPolicy:
         return NamedSharding(self.mesh, P(*spec))
 
     def weight_sharding(self, shape: Tuple[int, ...],
-                        sharding_dims: Optional[Tuple[Optional[str], ...]]
+                        sharding_dims: Optional[Tuple[Optional[str], ...]],
+                        shard_multiples: Optional[
+                            Tuple[Optional[int], ...]] = None
                         ) -> NamedSharding:
         """Parameters: replicated over 'data', split per the op's hint over
         'model'/'expert'. Dims that don't divide evenly fall back to
-        replication (XLA would pad; we keep it simple and correct)."""
+        replication (XLA would pad; we keep it simple and correct).
+        ``shard_multiples[i]``, when given, additionally requires the
+        per-device chunk of dim i to be a multiple of that unit (e.g.
+        head_dim, so attention TP splits at whole-head boundaries — see
+        WeightSpec.shard_multiples for the RoPE/partitioner rationale)."""
         if sharding_dims is None:
             return NamedSharding(self.mesh, P())
         spec = []
-        for dim_size, axis_name in zip(shape, sharding_dims):
+        for i, (dim_size, axis_name) in enumerate(zip(shape, sharding_dims)):
             ax = self._axis(axis_name)
-            if ax is not None and dim_size % self.mesh.shape[ax] == 0:
+            unit = (shard_multiples[i] or 1) if (
+                shard_multiples is not None
+                and i < len(shard_multiples)) else 1
+            if (ax is not None and dim_size % self.mesh.shape[ax] == 0
+                    and (dim_size // self.mesh.shape[ax]) % unit == 0):
                 spec.append(ax)
             else:
                 spec.append(None)

@@ -27,12 +27,22 @@ from flexflow_tpu.serve.request_manager import (
 )
 from flexflow_tpu.serve.inference_manager import InferenceManager
 from flexflow_tpu.serve.api import LLM, SSM, init
+from flexflow_tpu.serve.loadgen import (EngineHandle, LoadRunner, TenantSpec,
+                                        WorkloadSpec, build_schedule,
+                                        summarize, sweep)
 from flexflow_tpu.telemetry import (ServingTelemetry, disable_telemetry,
                                     enable_telemetry, get_telemetry)
 
 __all__ = [
+    "EngineHandle",
     "LLM",
+    "LoadRunner",
     "SSM",
+    "TenantSpec",
+    "WorkloadSpec",
+    "build_schedule",
+    "summarize",
+    "sweep",
     "ServingTelemetry",
     "disable_telemetry",
     "enable_telemetry",

@@ -46,6 +46,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from flexflow_tpu.utils.shard_map_compat import shard_map
+
 PP_PARAMS_KEY = "__pp_blocks__"
 
 _BLOCK_IDX_RE = re.compile(r"\.(\d+)\.")
@@ -436,7 +438,7 @@ def _pp_segment(model, plan):
 
     pipe_spec = jax.tree.map(lambda _: P("pipe"),
                              model.params[PP_PARAMS_KEY])
-    fn = jax.shard_map(
+    fn = shard_map(
         seg, mesh=mesh,
         in_specs=(pipe_spec, P("pipe"), P("pipe"), P(), P(), P()),
         out_specs=(P(), P("pipe"), P("pipe")),

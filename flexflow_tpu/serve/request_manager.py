@@ -62,10 +62,15 @@ class Request:
     cache_depth: int = 0                  # verifier/incr cache depth
     ssm_cache_depth: Dict[int, int] = dataclasses.field(default_factory=dict)
     finished: bool = False
-    # lifecycle timestamps (time.perf_counter; always recorded — two
+    # lifecycle timestamps (time.perf_counter; always recorded — three
     # clock reads per request lifetime — so GenerationResult latency
-    # fields exist even with telemetry disabled)
+    # fields exist even with telemetry disabled). prefill_start_s is
+    # stamped when the request wins a batch slot (admission -> slot is
+    # the queue wait; slot -> first token is the service time to first
+    # token). The native-scheduler path attributes both through a FIFO
+    # shadow of ffs_fill_slots (see _generate_incr_native).
     arrival_s: float = 0.0
+    prefill_start_s: float = 0.0
     first_token_s: float = 0.0
 
     def __post_init__(self):
@@ -93,6 +98,13 @@ class GenerationResult:
     # the token bookkeeping)
     latency_s: float = 0.0
     ttft_s: float = 0.0
+    # queue-wait vs service decomposition (SLO observability, loadgen):
+    # admission -> batch-slot grant, and slot grant -> first generated
+    # token. ttft_s == queue_wait_s + prefill_s wherever both are
+    # attributed (all scheduler paths, incl. the native one via its
+    # FIFO slot shadow); 0.0 only when attribution was impossible.
+    queue_wait_s: float = 0.0
+    prefill_s: float = 0.0
 
 
 class RequestManager:
@@ -171,10 +183,16 @@ class RequestManager:
             output_tokens=out,
             latency_s=(now - req.arrival_s) if req.arrival_s else 0.0,
             ttft_s=(req.first_token_s - req.arrival_s)
-            if req.first_token_s and req.arrival_s else 0.0)
+            if req.first_token_s and req.arrival_s else 0.0,
+            queue_wait_s=(req.prefill_start_s - req.arrival_s)
+            if req.prefill_start_s and req.arrival_s else 0.0,
+            prefill_s=(req.first_token_s - req.prefill_start_s)
+            if req.first_token_s and req.prefill_start_s else 0.0)
         tel = self._tel()
         if tel is not None:
-            tel.note_finish(req.guid, len(out), res.latency_s, res.ttft_s)
+            tel.note_finish(req.guid, len(out), res.latency_s, res.ttft_s,
+                            queue_wait_s=res.queue_wait_s,
+                            prefill_s=res.prefill_s)
         if self.tokenizer is not None:
             try:
                 res.input_text = self.tokenizer.decode(res.input_tokens)
@@ -202,6 +220,7 @@ class RequestManager:
                     done.append(self._collect(req))
                     continue
                 req.slot = slot
+                req.prefill_start_s = time.perf_counter()
                 active[slot] = req
 
     def _remaining_budget(self, req, max_seq: int) -> int:
@@ -380,14 +399,22 @@ class RequestManager:
         (native/src/batch_scheduler.cpp; same semantics as the Python loop
         above — parity-tested in tests/test_native.py)."""
         R = cfg.max_requests_per_batch
+        max_seq = cfg.max_sequence_length
         chunk = max(1, cfg.max_tokens_per_batch // max(1, min(R, 4)))
         reqs: Dict[int, Request] = {}
+        # FIFO shadow of the C++ scheduler's pending queue: ffs_fill_slots
+        # pops strictly in add order (rejecting over-long prompts along
+        # the way), so the Python side can attribute slot-grant times —
+        # the queue-wait/service decomposition — without a C ABI change.
+        unslotted = deque()
         while self.pending:
             req = self.pending.popleft()
             reqs[req.guid] = req
+            unslotted.append(req)
             sched.add_request(req.guid, req.prompt_tokens,
                               req.max_new_tokens, req.max_sequence_length)
         done: List[GenerationResult] = []
+        slotted: Dict[int, Request] = {}       # guid -> live slotted request
 
         def drain():
             while True:
@@ -398,11 +425,23 @@ class RequestManager:
                 req = reqs[guid]
                 req.tokens = tokens
                 req.finished = True
+                slotted.pop(guid, None)
                 done.append(self._collect(req))
+
+        def note_slots(placed: int):
+            now = time.perf_counter()
+            while placed > 0 and unslotted:
+                req = unslotted.popleft()
+                limit = min(req.max_sequence_length or max_seq, max_seq)
+                if len(req.prompt_tokens) >= limit:
+                    continue     # C++ rejected it straight to done
+                req.prefill_start_s = now
+                slotted[req.guid] = req
+                placed -= 1
 
         while sched.has_work():
             tel = self._tel()
-            sched.fill_slots()
+            note_slots(sched.fill_slots())
             drain()  # over-long prompts rejected straight to done
             rows, tokens, positions, start, num, act = \
                 sched.assemble_prefill(chunk, cfg.max_tokens_per_batch, chunk)
@@ -430,6 +469,13 @@ class RequestManager:
                     tel.record_decode_block(time.perf_counter() - t0,
                                             block, live)
                 sched.append_block(np.asarray(toks)[:, :block])
+                # every live slot emitted >= 1 token inside this fused
+                # block; first-token time is block-end granular, the same
+                # resolution the fused Python decode path records
+                now = time.perf_counter()
+                for req in slotted.values():
+                    if not req.first_token_s:
+                        req.first_token_s = now
             drain()
         return done
 

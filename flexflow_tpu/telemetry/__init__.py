@@ -34,10 +34,19 @@ ffsv_decode_block_seconds        histogram  device-fenced decode block time
 ffsv_spec_block_seconds          histogram  device-fenced speculation block
 ffsv_request_latency_seconds     histogram  admission -> finish
 ffsv_request_ttft_seconds        histogram  admission -> first token
+ffsv_request_queue_wait_seconds  histogram  admission -> batch-slot grant
+ffsv_request_prefill_seconds     histogram  slot grant -> first token
 ffsv_per_token_latency_seconds   histogram  latency / output tokens
 ffsv_draft_depth                 gauge      current speculation chain depth
 ffsv_tree_width                  gauge      verify-pass token-tree width
 ===============================  =========  =================================
+
+The request-level SLO histograms (latency/ttft/queue-wait/prefill/
+per-token) carry a sliding window (``slo_window_s``, default 60 s):
+``/metrics`` additionally exports ``<name>_window`` summaries with exact
+p50/p90/p99 over the trailing window, so a scrape under load reads the
+CURRENT tail, not the whole-run aggregate (serve/loadgen.py's live-SLO
+contract).
 
 Timing honesty: block/step timings are recorded by the serving loop
 AROUND device calls whose results are read back to the host
@@ -75,9 +84,13 @@ class ServingTelemetry:
     stack to one guarded line; they are the only place metric names are
     spelled, so the table in the module docstring stays the schema."""
 
-    def __init__(self, trace_path: Optional[str] = None):
+    SLO_WINDOW_S = 60.0
+
+    def __init__(self, trace_path: Optional[str] = None,
+                 slo_window_s: Optional[float] = None):
         self.registry = MetricsRegistry()
         self.tracer = SpanTracer(trace_path)
+        win = self.SLO_WINDOW_S if slo_window_s is None else slo_window_s
         r = self.registry
         self.requests_total = r.counter(
             "ffsv_requests_total", "requests admitted")
@@ -117,12 +130,20 @@ class ServingTelemetry:
             "ffsv_spec_block_seconds",
             "device-fenced fused speculation block time")
         self.request_latency = r.histogram(
-            "ffsv_request_latency_seconds", "admission -> finish")
+            "ffsv_request_latency_seconds", "admission -> finish",
+            window_s=win)
         self.request_ttft = r.histogram(
-            "ffsv_request_ttft_seconds", "admission -> first token")
+            "ffsv_request_ttft_seconds", "admission -> first token",
+            window_s=win)
+        self.request_queue_wait = r.histogram(
+            "ffsv_request_queue_wait_seconds",
+            "admission -> batch-slot grant", window_s=win)
+        self.request_prefill = r.histogram(
+            "ffsv_request_prefill_seconds",
+            "batch-slot grant -> first token", window_s=win)
         self.per_token_latency = r.histogram(
             "ffsv_per_token_latency_seconds",
-            "request latency / output tokens")
+            "request latency / output tokens", window_s=win)
         self.draft_depth = r.gauge(
             "ffsv_draft_depth", "current speculation chain depth")
         self.tree_width = r.gauge(
@@ -181,7 +202,8 @@ class ServingTelemetry:
                                      rounds_in_block)
 
     def note_finish(self, guid: int, output_tokens: int, latency_s: float,
-                    ttft_s: float):
+                    ttft_s: float, queue_wait_s: float = 0.0,
+                    prefill_s: float = 0.0):
         self.requests_finished.inc()
         self.tokens_generated.inc(output_tokens)
         if latency_s > 0:
@@ -190,6 +212,10 @@ class ServingTelemetry:
                 latency_s / max(1, output_tokens))
         if ttft_s > 0:
             self.request_ttft.observe(ttft_s)
+        if queue_wait_s > 0:
+            self.request_queue_wait.observe(queue_wait_s)
+        if prefill_s > 0:
+            self.request_prefill.observe(prefill_s)
         self.tracer.finish(guid, output_tokens, latency_s, ttft_s)
 
     def close(self):

@@ -22,6 +22,8 @@ from __future__ import annotations
 
 import json
 import math
+import time
+from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
 # Prometheus-style default latency buckets (seconds), wide enough for
@@ -113,14 +115,25 @@ class Histogram:
     and ``percentile(q)`` sorts the retained window. Export emits both
     forms: cumulative ``_bucket`` lines for Prometheus scrapers and a
     ``percentiles`` block in the JSON snapshot.
+
+    **Sliding window** (``window_s``): SLO gauges under live load must
+    answer "what is p99 RIGHT NOW", not "since process start" — a
+    whole-run aggregate buries a saturation spike under minutes of
+    healthy history. With ``window_s`` set, each observation also keeps
+    its timestamp in a time-bounded deque and ``windowed_percentiles()``
+    (and the ``window`` block of ``snapshot()`` / the ``{name}_window``
+    summary in the Prometheus exposition) covers only the last
+    ``window_s`` seconds. Timestamps default to ``time.monotonic()``;
+    tests inject explicit ``at=``/``now=`` values for determinism.
     """
 
     __slots__ = ("name", "help", "buckets", "_counts", "_sum", "_n",
-                 "_samples", "_cap", "_next")
+                 "_samples", "_cap", "_next", "window_s", "_win")
 
     def __init__(self, name: str, help: str = "",
                  buckets: Sequence[float] = DEFAULT_TIME_BUCKETS,
-                 sample_cap: int = 65536):
+                 sample_cap: int = 65536,
+                 window_s: Optional[float] = None):
         self.name = name
         self.help = help
         self.buckets = tuple(sorted(buckets))
@@ -130,8 +143,10 @@ class Histogram:
         self._samples: List[float] = []
         self._cap = int(sample_cap)
         self._next = 0                                  # ring write cursor
+        self.window_s = window_s
+        self._win: Optional[deque] = deque() if window_s else None
 
-    def observe(self, v: float):
+    def observe(self, v: float, at: Optional[float] = None):
         v = float(v)
         # linear scan beats bisect for the short bucket lists used here
         for i, b in enumerate(self.buckets):
@@ -147,10 +162,24 @@ class Histogram:
         else:                       # ring overwrite keeps a recent window
             self._samples[self._next] = v
             self._next = (self._next + 1) % self._cap
+        if self._win is not None:
+            t = time.monotonic() if at is None else at
+            self._win.append((t, v))
+            self._evict(t)
 
     def observe_many(self, values):
         for v in values:
             self.observe(v)
+
+    def _evict(self, now: float):
+        cutoff = now - self.window_s
+        win = self._win
+        while win and win[0][0] < cutoff:
+            win.popleft()
+        # cap the window's memory too (a burst far above sample_cap
+        # within one window would otherwise grow without bound)
+        while len(win) > self._cap:
+            win.popleft()
 
     def reset(self):
         self._counts = [0] * (len(self.buckets) + 1)
@@ -158,6 +187,8 @@ class Histogram:
         self._n = 0
         self._samples = []
         self._next = 0
+        if self._win is not None:
+            self._win.clear()
 
     @property
     def count(self) -> int:
@@ -170,13 +201,35 @@ class Histogram:
     def percentile(self, q: float) -> float:
         return percentile(sorted(self._samples), q)
 
+    def windowed_percentiles(self, qs: Sequence[float] = (50, 90, 99),
+                             now: Optional[float] = None) -> dict:
+        """Exact percentiles over the trailing ``window_s`` seconds:
+        ``{"count", "sum", "p<q>": ...}``. Empty dict when the histogram
+        has no window configured; ``count`` 0 and no percentile keys
+        when the window holds no samples.
+
+        Called from scrape threads while the serving thread observes:
+        NEVER mutates the deque (eviction is writer-only, in observe) and
+        copies it atomically first — ``list(deque)`` runs entirely in C
+        under the GIL, whereas iterating the live deque would raise
+        "deque mutated during iteration" mid-scrape."""
+        if self._win is None:
+            return {}
+        cutoff = (time.monotonic() if now is None else now) - self.window_s
+        vals = sorted(v for t, v in list(self._win) if t >= cutoff)
+        out = {"count": len(vals), "sum": float(sum(vals))}
+        if vals:
+            for q in qs:
+                out[f"p{q:g}"] = percentile(vals, q)
+        return out
+
     def snapshot(self) -> dict:
         srt = sorted(self._samples)
         cum, counts = 0, []
         for c in self._counts:
             cum += c
             counts.append(cum)
-        return {
+        snap = {
             "type": "histogram",
             "count": self._n,
             "sum": self._sum,
@@ -188,6 +241,10 @@ class Histogram:
                 "p99": percentile(srt, 99),
             } if srt else {},
         }
+        if self.window_s:
+            snap["window"] = {"seconds": self.window_s,
+                              **self.windowed_percentiles()}
+        return snap
 
 
 class MetricsRegistry:
@@ -217,9 +274,10 @@ class MetricsRegistry:
         return self._get_or_create(Gauge, name, help)
 
     def histogram(self, name: str, help: str = "",
-                  buckets: Sequence[float] = DEFAULT_TIME_BUCKETS
-                  ) -> Histogram:
-        return self._get_or_create(Histogram, name, help, buckets=buckets)
+                  buckets: Sequence[float] = DEFAULT_TIME_BUCKETS,
+                  window_s: Optional[float] = None) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets,
+                                   window_s=window_s)
 
     def get(self, name: str):
         return self._metrics.get(name)
@@ -261,6 +319,22 @@ class MetricsRegistry:
                 lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
                 lines.append(f"{name}_sum {_fmt(m.sum)}")
                 lines.append(f"{name}_count {m.count}")
+                if m.window_s:
+                    # live SLO view: exact quantiles over the trailing
+                    # window, exported as a Prometheus summary so
+                    # scrapers see CURRENT tail latency, not the
+                    # whole-run aggregate above
+                    w = m.windowed_percentiles()
+                    lines.append(f"# TYPE {name}_window summary")
+                    for q in (50, 90, 99):
+                        if f"p{q}" in w:
+                            # Prometheus quantile labels are minimal-form
+                            # decimals ("0.5", not "0.50")
+                            lines.append(
+                                f'{name}_window{{quantile="{q / 100:g}"}} '
+                                f'{_fmt(w[f"p{q}"])}')
+                    lines.append(f"{name}_window_sum {_fmt(w['sum'])}")
+                    lines.append(f"{name}_window_count {w['count']}")
         return "\n".join(lines) + ("\n" if lines else "")
 
 

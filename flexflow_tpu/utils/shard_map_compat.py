@@ -1,0 +1,65 @@
+"""``jax.shard_map`` compatibility shim.
+
+``jax.shard_map`` graduated out of ``jax.experimental.shard_map`` only in
+jax 0.4.x-late / 0.5; this tree must run on 0.4.37, where the top-level
+name is absent and the experimental form takes the OLD keyword set
+(``check_rep`` instead of ``check_vma``, ``auto`` instead of
+``axis_names``). Every call site in the repo routes through
+:func:`shard_map` below so the version skew lives in exactly one place:
+
+* when ``jax.shard_map`` exists it is called through unchanged;
+* otherwise the call is translated onto
+  ``jax.experimental.shard_map.shard_map``: ``check_vma=X`` ->
+  ``check_rep=X``, and ``axis_names=S`` (partial manual) becomes FULL
+  manual — the experimental ``auto=`` lowering emits a PartitionId
+  instruction the CPU SPMD partitioner rejects, and full manual is
+  value-identical as long as the in/out specs only name axes in ``S``
+  (every call site in this repo; axes outside ``S`` then carry
+  replicated values and redundantly repeat the region's compute).
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None, *,
+              axis_names=None, check_vma=None, **kwargs):
+    """Version-portable ``jax.shard_map``.
+
+    Accepts the MODERN keyword vocabulary (``axis_names``/``check_vma``)
+    and translates for the experimental fallback. ``mesh`` is required
+    by both implementations; extra ``kwargs`` pass through untouched on
+    the modern path and raise on the fallback (better a loud error than
+    a silently-dropped semantic).
+    """
+    if hasattr(jax, "shard_map"):
+        kw = dict(kwargs)
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    if kwargs:
+        raise TypeError(
+            f"shard_map compat fallback (jax {jax.__version__}) does not "
+            f"support kwargs {sorted(kwargs)}")
+    kw = {}
+    if check_vma is not None:
+        kw["check_rep"] = check_vma
+    # axis_names (partial manual) maps to the experimental ``auto=`` set,
+    # but that lowering emits PartitionId — UNIMPLEMENTED in this
+    # jaxlib's CPU SPMD partitioner (measured: auto={"data"} on a
+    # pipe x data mesh fails, full manual runs). Go FULL manual instead:
+    # axes outside ``axis_names`` see replicated inputs (their in_specs
+    # don't mention them) and compute identical per-shard values, so
+    # results match partial-auto exactly — at the cost of redundant
+    # compute on those axes, the right trade for a compat fallback.
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)

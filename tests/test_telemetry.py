@@ -13,18 +13,11 @@ import urllib.request
 
 import pytest
 
-import flexflow_tpu as ff
-from flexflow_tpu.ffconst import InferenceMode
-from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
 from flexflow_tpu.serve.request_manager import RequestManager
 from flexflow_tpu.telemetry import (MetricsHTTPServer, MetricsRegistry,
                                     SpanTracer, disable_telemetry,
                                     enable_telemetry, get_telemetry,
                                     load_jsonl)
-
-TINY = LLAMAConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
-                   num_hidden_layers=2, num_attention_heads=4,
-                   num_key_value_heads=2, max_position_embeddings=128)
 
 
 # ---------------------------------------------------------------------------
@@ -119,31 +112,17 @@ def test_metrics_http_endpoint():
 
 
 # ---------------------------------------------------------------------------
-# serving integration (one shared tiny spec pair)
+# serving integration (tiny spec pair shared session-wide with test_loadgen
+# via conftest.tiny_spec_pair — tier-1 budget: one build, many tests)
 # ---------------------------------------------------------------------------
 
-@pytest.fixture(scope="module")
-def spec_pair():
-    def make(mode):
-        cfg = ff.FFConfig(max_requests_per_batch=2, max_sequence_length=64,
-                          max_tokens_per_batch=16, seed=0,
-                          kv_cache_dtype="float32")
-        m = ff.FFModel(cfg)
-        create_llama_model(m, TINY, mode=mode)
-        m.compile(comp_mode=ff.CompMode.COMP_MODE_INFERENCE)
-        return m
-
-    return (make(InferenceMode.TREE_VERIFY_MODE),
-            make(InferenceMode.BEAM_SEARCH_MODE))
-
-
-def test_spec_decode_records_expected_telemetry(spec_pair, tmp_path):
+def test_spec_decode_records_expected_telemetry(tiny_spec_pair, tmp_path):
     """A 2-round speculative decode (depth 2, same-weights draft -> full
     acceptance, 3 tokens/round, 6-token budget) must produce the JSONL
     span trace plus a metrics snapshot with the exact acceptance-length
     events, per-round token counts, batch occupancy and p50/p99
     per-token latency — the subsystem's acceptance criteria."""
-    llm, ssm = spec_pair
+    llm, ssm = tiny_spec_pair
     trace = str(tmp_path / "spec.jsonl")
     tel = enable_telemetry(trace_path=trace)
     try:
@@ -171,6 +150,15 @@ def test_spec_decode_records_expected_telemetry(spec_pair, tmp_path):
         assert lat.count == 2
         assert 0 < lat.percentile(50) <= lat.percentile(99)
         assert reg.get("ffsv_request_latency_seconds").count == 2
+        # queue-wait/service decomposition histograms (loadgen SLO seam)
+        assert reg.get("ffsv_request_queue_wait_seconds").count == 2
+        assert reg.get("ffsv_request_prefill_seconds").count == 2
+        # SLO histograms carry the sliding window: fresh traffic is
+        # inside it, so windowed p99 == whole-run exact p99 here
+        win = reg.get("ffsv_request_latency_seconds").windowed_percentiles()
+        assert win["count"] == 2
+        assert win["p99"] == pytest.approx(
+            reg.get("ffsv_request_latency_seconds").percentile(99))
         # exporters carry the same story
         text = reg.to_prometheus()
         assert "ffsv_acceptance_length_bucket" in text
@@ -189,15 +177,20 @@ def test_spec_decode_records_expected_telemetry(spec_pair, tmp_path):
     guids = {r.guid for r in results}
     assert {e["tid"] for e in rounds} == guids
     assert any(e["name"] == "prefill" for e in evs)
-    # latency fields surfaced on the results themselves (serve/api.py)
+    # latency fields surfaced on the results themselves (serve/api.py),
+    # including the queue-wait/service decomposition: admission->slot +
+    # slot->first-token exactly partition TTFT on this scheduler path
     assert all(r.latency_s > 0 and r.ttft_s > 0 for r in results)
+    assert all(r.queue_wait_s >= 0 and r.prefill_s > 0 for r in results)
+    assert all(r.ttft_s == pytest.approx(r.queue_wait_s + r.prefill_s)
+               for r in results)
 
 
-def test_disabled_path_records_no_events(spec_pair):
+def test_disabled_path_records_no_events(tiny_spec_pair):
     """With telemetry disabled the decode round must record NOTHING — no
     global registry exists and a freshly enabled one afterwards is empty
     (the zero-overhead guard for the disabled path)."""
-    llm, ssm = spec_pair
+    llm, ssm = tiny_spec_pair
     disable_telemetry()
     assert get_telemetry() is None
     rm = RequestManager()

@@ -1,0 +1,199 @@
+"""Bench-trajectory regression gate over the BENCH_r*.json history.
+
+The driver appends one ``BENCH_rNN.json`` per round ({"n", "rc", "parsed":
+<bench.py JSON line>}); until now that trajectory was a pile of files a
+human eyeballed. This tool turns it into an enforced gate:
+
+* default mode prints the per-metric trend table (round by round, grouped
+  by bench config so the r01 1.3B-class line is never compared against
+  the 7B int8 rounds);
+* ``--check`` compares the LATEST successful round's headline metrics
+  against the best prior value in the same config group and exits 1 with
+  a readable diff when any drops beyond its tolerance.
+
+Headline metrics and tolerances live in :data:`HEADLINES` — dotted paths
+reach into nested sections (``serving_load.peak_tokens_per_s`` is the
+closed-loop load line bench.py emits). All gated metrics are
+higher-is-better; rounds with ``rc != 0`` or no parsed payload (e.g. the
+r02 tunnel flake) are skipped, not failed — the gate polices regressions,
+not infrastructure weather.
+
+Usage::
+
+    python tools/bench_trend.py                 # trend table
+    python tools/bench_trend.py --check         # CI gate (exit 1 on regression)
+    python tools/bench_trend.py --check --dir . --tolerance value=0.05
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# metric dotted-path -> relative drop tolerance (fraction; fail when the
+# latest round is more than this far below the best prior same-config
+# value). Calibrated against the committed r01-r05 history: the largest
+# benign drop is resnet_train_mfu r05 0.251 vs r04 0.274 (-8.4%, a known
+# rep-spread artifact — ROADMAP housekeeping), hence its looser bound.
+HEADLINES: Dict[str, float] = {
+    "value": 0.08,                       # specinfer tokens/s
+    "vs_baseline": 0.05,
+    "incr_tokens_per_s": 0.08,
+    "roofline_pct": 0.05,
+    "tokens_per_round": 0.10,
+    "bf16_vs_baseline": 0.05,
+    "train_mfu": 0.10,
+    "resnet_train_mfu": 0.15,
+    "serving_load.peak_tokens_per_s": 0.10,
+    "serving_load.peak_goodput_tokens_per_s": 0.10,
+    "serving_load.knee_rps": 0.34,       # knee is step-quantized: only a
+                                         # lost step (/step-mult) is real
+}
+
+
+def _get_path(d: dict, path: str):
+    cur = d
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur if isinstance(cur, (int, float)) and not isinstance(
+        cur, bool) else None
+
+
+def load_rounds(bench_dir: str, pattern: str = "BENCH_r*.json"
+                ) -> List[dict]:
+    """Parse the trajectory, ordered by round number. Each entry:
+    {"round", "file", "ok", "config", "parsed"} — ``ok`` False for
+    failed/empty rounds (kept for the table, skipped by the gate)."""
+    rounds = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, pattern))):
+        try:
+            doc = json.load(open(path))
+        except (OSError, json.JSONDecodeError) as e:
+            rounds.append({"round": -1, "file": os.path.basename(path),
+                           "ok": False, "config": None, "parsed": {},
+                           "error": str(e)})
+            continue
+        parsed = doc.get("parsed") or {}
+        m = re.search(r"r(\d+)", os.path.basename(path))
+        n = doc.get("n", int(m.group(1)) if m else -1)
+        ok = doc.get("rc", 1) == 0 and bool(parsed) \
+            and parsed.get("value") is not None
+        rounds.append({"round": n, "file": os.path.basename(path),
+                       "ok": ok, "config": parsed.get("config"),
+                       "parsed": parsed})
+    rounds.sort(key=lambda r: r["round"])
+    return rounds
+
+
+def check_trajectory(rounds: Sequence[dict],
+                     tolerances: Optional[Dict[str, float]] = None
+                     ) -> Tuple[List[str], List[str]]:
+    """Gate the LATEST successful round against the best prior value per
+    headline metric within the same config group. Returns (regressions,
+    report_lines); empty regressions == gate passes. Metrics absent from
+    either side are skipped (sections appear over time — the gate only
+    ever compares like with like)."""
+    tol = dict(HEADLINES)
+    tol.update(tolerances or {})
+    ok_rounds = [r for r in rounds if r["ok"]]
+    lines = []
+    if not ok_rounds:
+        return [], ["no successful rounds — nothing to gate"]
+    latest = ok_rounds[-1]
+    prior = [r for r in ok_rounds[:-1] if r["config"] == latest["config"]]
+    lines.append(
+        f"gating r{latest['round']:02d} (config {latest['config']!r}) "
+        f"against {len(prior)} prior same-config round(s)")
+    if not prior:
+        lines.append("no prior same-config rounds — gate passes vacuously")
+        return [], lines
+    regressions = []
+    for metric, t in sorted(tol.items()):
+        cur = _get_path(latest["parsed"], metric)
+        if cur is None:
+            continue
+        best, best_round = None, None
+        for r in prior:
+            v = _get_path(r["parsed"], metric)
+            if v is not None and (best is None or v > best):
+                best, best_round = v, r["round"]
+        if best is None or best <= 0:
+            continue
+        drop = (best - cur) / best
+        tag = "REGRESSION" if drop > t else "ok"
+        lines.append(
+            f"  {tag:>10}  {metric:<40} {cur:>10.4g}  vs best "
+            f"r{best_round:02d} {best:.4g}  ({-drop * 100:+.1f}%, "
+            f"tol -{t * 100:.0f}%)")
+        if drop > t:
+            regressions.append(
+                f"{metric}: r{latest['round']:02d} {cur:.4g} vs best "
+                f"r{best_round:02d} {best:.4g} "
+                f"({-drop * 100:+.1f}% > -{t * 100:.0f}% tolerance)")
+    return regressions, lines
+
+
+def trend_table(rounds: Sequence[dict]) -> str:
+    """Round-by-round values of every headline metric present anywhere."""
+    metrics = [m for m in HEADLINES
+               if any(_get_path(r["parsed"], m) is not None for r in rounds)]
+    w = max((len(m) for m in metrics), default=6)
+    head = "metric".ljust(w) + "".join(
+        f"  r{r['round']:02d}{'' if r['ok'] else '!'}".rjust(10)
+        for r in rounds)
+    lines = [head]
+    for m in metrics:
+        row = m.ljust(w)
+        for r in rounds:
+            v = _get_path(r["parsed"], m)
+            row += (f"{v:>10.4g}" if v is not None else f"{'-':>10}")
+        lines.append(row)
+    lines.append("(! = failed round, excluded from the gate)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="bench-trajectory trend viewer / regression gate")
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--glob", default="BENCH_r*.json")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 when the latest round regressed")
+    ap.add_argument("--tolerance", action="append", default=[],
+                    metavar="METRIC=FRAC",
+                    help="override a tolerance, e.g. value=0.05 "
+                         "(repeatable)")
+    args = ap.parse_args(argv)
+    overrides = {}
+    for spec in args.tolerance:
+        k, _, v = spec.partition("=")
+        overrides[k] = float(v)
+    rounds = load_rounds(args.dir, args.glob)
+    if not rounds:
+        print(f"no {args.glob} files under {args.dir}", file=sys.stderr)
+        return 2
+    print(trend_table(rounds))
+    regressions, lines = check_trajectory(rounds, overrides)
+    print()
+    print("\n".join(lines))
+    if args.check:
+        if regressions:
+            print("\nBENCH TREND GATE FAILED:", file=sys.stderr)
+            for r in regressions:
+                print(f"  {r}", file=sys.stderr)
+            return 1
+        print("\nbench trend gate: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,177 @@
+"""Closed-loop load harness CLI: drive the serving stack with seeded
+arrival-driven traffic and print the live-SLO knee sweep.
+
+Builds a tiny (CPU-friendly) or 1.3B/7B-geometry LLaMA serving model,
+replays a seeded Poisson (or fixed-rate) schedule per offered-load step
+through the background-server submission queue, and prints per step:
+offered vs achieved req/s, throughput and goodput tokens/s, TTFT /
+request-latency p50/p99, and the queue-wait vs service decomposition —
+then the saturation knee (max sustained req/s under the TTFT p99 bound).
+
+Examples::
+
+    python tools/loadtest.py --seed 0 --rate 4 --steps 3
+    python tools/loadtest.py --rate 2 --steps 4 --step-mult 2 \
+        --requests 16 --deadline 5 --p99-bound 2.0 --spec
+    python tools/loadtest.py --rate 8 --steps 3 --closed 8 --json out.json
+    python tools/loadtest.py --rate 8 --steps 3 --metrics-port 9600
+
+``--metrics-port`` starts the /metrics endpoint during the run so a
+scraper (or curl) can watch the sliding-window SLO summaries move under
+load — the live view the whole-run report below aggregates.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GEOMETRIES = {
+    # name: (vocab, hidden, inter, layers, heads, kv_heads, max_seq)
+    "tiny": (128, 64, 128, 2, 4, 2, 64),
+    "small": (512, 128, 256, 4, 4, 4, 256),
+}
+
+
+def build_handle(args):
+    import flexflow_tpu as ff
+    from flexflow_tpu.ffconst import InferenceMode
+    from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+    from flexflow_tpu.serve.loadgen import EngineHandle
+
+    vocab, hidden, inter, layers, heads, kv, max_seq = GEOMETRIES[args.geometry]
+    mcfg = LLAMAConfig(vocab_size=vocab, hidden_size=hidden,
+                       intermediate_size=inter, num_hidden_layers=layers,
+                       num_attention_heads=heads, num_key_value_heads=kv,
+                       max_position_embeddings=max_seq)
+    cfg = ff.FFConfig(max_requests_per_batch=args.slots,
+                      max_sequence_length=max_seq,
+                      max_tokens_per_batch=4 * args.slots,
+                      seed=args.seed, kv_cache_dtype="float32")
+
+    def build(mode, n_layers=None):
+        mc = mcfg if n_layers is None else LLAMAConfig(
+            **{**mcfg.__dict__, "num_hidden_layers": n_layers})
+        m = ff.FFModel(cfg)
+        create_llama_model(m, mc, mode=mode)
+        m.compile(comp_mode=ff.CompMode.COMP_MODE_INFERENCE)
+        return m
+
+    if args.spec:
+        llm = build(InferenceMode.TREE_VERIFY_MODE)
+        ssm = build(InferenceMode.BEAM_SEARCH_MODE, n_layers=1)
+        for lname, lp in ssm.params.items():
+            if lname in llm.params:
+                for w in lp:
+                    ssm.params[lname][w] = llm.params[lname][w]
+        return EngineHandle(llm, ssms=[ssm], spec_depth=args.spec_depth), vocab
+    return EngineHandle(build(InferenceMode.INC_DECODING_MODE)), vocab
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="closed-loop serving load harness with SLO knee sweep")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--rate", type=float, default=4.0,
+                    help="offered load of the FIRST step (req/s)")
+    ap.add_argument("--steps", type=int, default=3,
+                    help="number of offered-load steps")
+    ap.add_argument("--step-mult", type=float, default=2.0,
+                    help="rate multiplier between steps")
+    ap.add_argument("--requests", type=int, default=8,
+                    help="requests per step")
+    ap.add_argument("--arrivals", choices=("poisson", "uniform"),
+                    default="poisson")
+    ap.add_argument("--closed", type=int, default=None, metavar="K",
+                    help="closed-loop concurrency cap (default: open loop)")
+    ap.add_argument("--deadline", type=float, default=None,
+                    help="per-request completion deadline (s) for goodput")
+    ap.add_argument("--p99-bound", type=float, default=5.0,
+                    help="TTFT p99 bound (s) defining the knee")
+    ap.add_argument("--geometry", choices=sorted(GEOMETRIES), default="tiny")
+    ap.add_argument("--slots", type=int, default=4,
+                    help="max_requests_per_batch")
+    ap.add_argument("--spec", action="store_true",
+                    help="serve speculatively (1-layer truncation draft)")
+    ap.add_argument("--spec-depth", type=int, default=2)
+    ap.add_argument("--prompt-lens", default="4,8,16")
+    ap.add_argument("--output-lens", default="4,8,16")
+    ap.add_argument("--tenants", default="default:1",
+                    help="comma list of name:weight[:deadline_s]")
+    ap.add_argument("--platform", choices=("cpu", "default"), default="cpu",
+                    help="'cpu' (default) forces the CPU backend — the "
+                         "harness measures scheduling, not chip speed; "
+                         "'default' keeps the session platform (e.g. the "
+                         "axon TPU tunnel)")
+    ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="also write the sweep result as JSON")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="expose /metrics (live sliding-window SLOs) "
+                         "during the run")
+    args = ap.parse_args(argv)
+
+    if args.platform == "cpu":
+        # the axon sitecustomize force-sets jax_platforms at interpreter
+        # start and IGNORES the JAX_PLATFORMS env var — config.update
+        # before first backend use is the only reliable override
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from flexflow_tpu.serve.loadgen import (TenantSpec, WorkloadSpec,
+                                            format_report, sweep)
+    from flexflow_tpu.telemetry import ensure_telemetry
+
+    tel = ensure_telemetry()
+    srv = None
+    if args.metrics_port is not None:
+        from flexflow_tpu.telemetry import MetricsHTTPServer
+
+        srv = MetricsHTTPServer(lambda: tel.registry, port=args.metrics_port)
+        print(f"# /metrics on http://{srv.host}:{srv.port}/metrics",
+              file=sys.stderr)
+
+    tenants = []
+    for part in args.tenants.split(","):
+        bits = part.split(":")
+        tenants.append(TenantSpec(
+            name=bits[0], weight=float(bits[1]) if len(bits) > 1 else 1.0,
+            deadline_s=float(bits[2]) if len(bits) > 2 else args.deadline))
+
+    t0 = time.perf_counter()
+    handle, vocab = build_handle(args)
+    print(f"# model built in {time.perf_counter() - t0:.1f}s "
+          f"({args.geometry}, {'spec' if args.spec else 'incr'})",
+          file=sys.stderr)
+    spec = WorkloadSpec(
+        prompt_lens=tuple(int(x) for x in args.prompt_lens.split(",")),
+        output_lens=tuple(int(x) for x in args.output_lens.split(",")),
+        tenants=tuple(tenants), vocab_size=vocab)
+    rates = [args.rate * args.step_mult ** i for i in range(args.steps)]
+    try:
+        result = sweep(handle, spec, rates, args.requests, seed=args.seed,
+                       process=args.arrivals,
+                       closed_concurrency=args.closed,
+                       p99_ttft_bound_s=args.p99_bound,
+                       timeout_s=args.timeout)
+    finally:
+        handle.stop_server()
+        if srv is not None:
+            srv.stop()
+    print(format_report(result))
+    if result["steps"] and "per_tenant" in result["steps"][-1]:
+        print("per-tenant (last step): "
+              + json.dumps(result["steps"][-1]["per_tenant"]))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=2)
+        print(f"# wrote {args.json}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
