@@ -59,6 +59,22 @@ SMOKE = "--smoke" in sys.argv or os.environ.get("FF_TPU_BENCH_SMOKE") == "1"
 # fused MultiSpecEngine tree path instead of the single-SSM chain engine —
 # the reference's multi-SSM SpecInfer configuration
 MULTI = "--multi-ssm" in sys.argv
+# --static-spec: disable the adaptive speculation controller
+# (serve/spec_controller.py) for A/B debugging — the DEFAULT is adaptive,
+# so the acceptance-realism sweep below measures the controller's
+# never-lose-to-incremental contract (ROADMAP item 1 gate)
+STATIC_SPEC = "--static-spec" in sys.argv
+
+
+def gen_cfg():
+    """Generation policy for every spec pass: None = library default
+    (adaptive controller ON); --static-spec pins the legacy fixed-depth
+    engine behavior."""
+    if STATIC_SPEC:
+        from flexflow_tpu.serve.batch_config import GenerationConfig
+
+        return GenerationConfig(adaptive_spec=False)
+    return None
 
 # Verifier geometry; draft = its first DRAFT_LAYERS layers.
 if SMOKE:                 # tiny CI smoke geometry
@@ -315,10 +331,12 @@ class AcceptanceMeter:
         for cls in (MultiSpecEngine, SpecChainEngine):
             orig = cls.run_block
 
-            def patched(eng, tok, pos, act, n, remaining=None, _orig=orig):
-                a, n_acc = _orig(eng, tok, pos, act, n, remaining)
+            def patched(eng, tok, pos, act, n, remaining=None, _orig=orig,
+                        **kw):
+                a, n_acc, d_used = _orig(eng, tok, pos, act, n, remaining,
+                                         **kw)
                 meter.n_acc.append(np.asarray(n_acc))
-                return a, n_acc
+                return a, n_acc, d_used
 
             cls.run_block = patched
             origs.append((cls, orig))
@@ -394,6 +412,8 @@ def _bf16_companion_line():
         for flag in ("--draft-layers", "--spec-depth"):
             if flag in sys.argv:
                 extra += [flag, str(_arg_int(flag, 0))]
+        if STATIC_SPEC:
+            extra += ["--static-spec"]
         # best-of-2 whole-child runs: the measured run-to-run spread on
         # this line is ~±7% (r5 tuning matrix: 1.79-2.03 across reps of
         # one config), far above the in-child best-of-2 timed passes'
@@ -510,9 +530,9 @@ def main():
         ifm.decode_block(tok0, pos0, act0, 1)
         eng.run_block(tok0, pos0, act0, 1)
         run_requests(lambda rm: rm.generate_incr_decoding(llm), warm, 4)
-        run_requests(lambda rm: rm.generate_spec_infer(llm, ssms,
-                                                       spec_depth=SPEC_DEPTH),
-                     warm, 4)
+        run_requests(lambda rm: rm.generate_spec_infer(
+            llm, ssms, spec_depth=SPEC_DEPTH, generation_config=gen_cfg()),
+            warm, 4)
         np.asarray(llm.op_state["kv_cache"]["k"][0, 0, 0, 0])
 
     with_retry(warmup, "warmup compile")
@@ -543,7 +563,8 @@ def main():
     try:
         spec_tps, spec_res = with_retry(
             lambda: max((run_requests(lambda rm: rm.generate_spec_infer(
-                llm, ssms, spec_depth=SPEC_DEPTH), prompts, NEW_TOKENS)
+                llm, ssms, spec_depth=SPEC_DEPTH,
+                generation_config=gen_cfg()), prompts, NEW_TOKENS)
                 for _ in range(2)), key=lambda r: r[0]),
             "spec-infer timed pass")
     finally:
@@ -597,14 +618,20 @@ def main():
                     tps_e, _res_e = with_retry(
                         lambda: run_requests(
                             lambda rm: rm.generate_spec_infer(
-                                llm, ssms, spec_depth=SPEC_DEPTH),
+                                llm, ssms, spec_depth=SPEC_DEPTH,
+                                generation_config=gen_cfg()),
                             prompts, NEW_TOKENS), f"sweep eps={eps}")
                 finally:
                     meter2._restore()
                 st = meter2.stats()
+                # spec_rounds: with the adaptive controller on, collapsed
+                # regimes should show FEW speculation rounds (the rest of
+                # the tokens came through the incremental fallback) — the
+                # observable that explains a recovered speedup_vs_incr
                 sweep.append({
                     "eps": eps,
                     "tokens_per_round": st.get("tokens_per_round"),
+                    "spec_rounds": st.get("rounds"),
                     "speedup_vs_incr": round(tps_e / incr_tps, 3)})
         except Exception as e:
             sweep.append({"error": str(e)[:200]})
@@ -643,6 +670,11 @@ def main():
         "unit": "tokens/s",
         "vs_baseline": round(spec_tps / incr_tps, 3),
         "incr_tokens_per_s": round(incr_tps, 2),
+        # adaptive speculation controller engaged for every spec pass in
+        # this line (incl. the child bf16 sweep — --static-spec forwards);
+        # bench_trend's absolute never-lose floor keys off this marker so
+        # pre-controller history isn't retroactively floored
+        "adaptive_spec": not STATIC_SPEC,
         **roofline,
         # full-length match is informational (typically 8/8 on this int8
         # config): the position a token is verified at depends on the

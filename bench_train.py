@@ -256,8 +256,16 @@ def measure_resnet_mfu(steps: int = 8, chip: str = None,
         st = (p, o, s)
         float(losses[-1])
     calls = max(1, steps // K)
+    # PR-12's per-rep spread instrumentation (tools/profile_resnet.py via
+    # telemetry histograms) root-caused the driver's median-0.251 vs
+    # best->=0.27 gap as REP SPREAD concentrated in the first post-warmup
+    # block: rep 0 still absorbs allocator/donation-cycle settling that
+    # the two warm calls don't fully drain on the remote runtime. Time
+    # one extra block and DROP rep 0 from the median — the steady-state
+    # number is the honest one — while reporting it beside the kept reps
+    # so the artifact stays visible (BASELINE.md note).
     block_dts = []
-    for b in range(blocks):
+    for b in range(blocks + 1):
         t0 = time.perf_counter()
         for i in range(calls):
             p, o, s, losses, _ = block_fn(*st, feeds_stack, labels, rngs)
@@ -265,7 +273,11 @@ def measure_resnet_mfu(steps: int = 8, chip: str = None,
         final_loss = float(losses[-1])       # single fence per block
         block_dts.append((time.perf_counter() - t0) / (calls * K))
     model.params, model.opt_state, model.op_state = st
-    return _mfu_report(block_dts, flops, chip, "resnet_train", final_loss)
+    from flexflow_tpu.search.machine_model import TPU_CHIPS
+
+    rep0_mfu = round(flops / block_dts[0] / TPU_CHIPS[chip].bf16_flops, 3)
+    return _mfu_report(block_dts[1:], flops, chip, "resnet_train",
+                       final_loss, extra={"resnet_train_rep0_mfu": rep0_mfu})
 
 
 if __name__ == "__main__":

@@ -2,26 +2,46 @@
  * — the role of the reference's C++ spec_infer main
  * (reference inference/spec_infer/spec_infer.cc:201: build LLM in tree
  * -verify mode + SSMs in beam-search mode, register requests,
- * generate). The draft here is a 2-layer truncation of the verifier —
- * the same seeded per-layer-name init makes the shallow weights match
- * automatically, so acceptance is non-trivial even without real
- * checkpoints (weights load via the spec's "weights_npz" in
- * production).
+ * generate). The drafts here are 1- and 2-layer truncations of the
+ * verifier — the same seeded per-layer-name init makes the shallow
+ * weights match automatically, so acceptance is non-trivial even
+ * without real checkpoints (weights load via the spec's "weights_npz"
+ * in production).
+ *
+ * Exercises the full spec-JSON surface: a multi-SSM draft set
+ * ({"ssms": [...]}) and a "generation_config" adaptive-speculation
+ * policy (depth bounds + fallback threshold) on the verifier — the
+ * per-request depth controller that keeps spec decoding from ever
+ * losing to plain incremental decoding, engaged identically for
+ * embedded C hosts and the Python stack.
  *
  *   cc spec_infer.c -L../../native/build -lflexflow_tpu_serve \
  *      -lpython3.12 -o spec_infer
  *   ./spec_infer /path/to/repo
  */
 #include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
 
 #include "../../native/include/flexflow_tpu_c.h"
 
-#define MODEL_JSON(layers)                                              \
-  "{\"family\": \"llama\", \"model_config\": {"                         \
+#define MODEL_CORE(layers)                                              \
+  "\"family\": \"llama\", \"model_config\": {"                          \
   "\"vocab_size\": 128, \"hidden_size\": 64, "                          \
   "\"intermediate_size\": 128, \"num_hidden_layers\": " #layers ", "    \
   "\"num_attention_heads\": 4, \"num_key_value_heads\": 2, "            \
-  "\"max_position_embeddings\": 64}}"
+  "\"max_position_embeddings\": 64}"
+
+/* verifier: 4 layers + the adaptive-speculation policy */
+#define VERIFIER_JSON                                                   \
+  "{" MODEL_CORE(4) ", \"generation_config\": {"                        \
+  "\"adaptive\": true, \"spec_depth\": 3, \"min_spec_depth\": 1, "      \
+  "\"fallback_margin\": 0.95, \"recover_margin\": 1.05, "               \
+  "\"probe_every\": 4}}"
+
+/* drafts: two truncations proposing into one merged token tree */
+#define DRAFTS_JSON                                                     \
+  "{\"ssms\": [{" MODEL_CORE(2) "}, {" MODEL_CORE(1) "}]}"
 
 int main(int argc, char **argv) {
   const char *repo_root = argc > 1 ? argv[1] : NULL;
@@ -34,8 +54,10 @@ int main(int argc, char **argv) {
   ffsv_config_set(cfg, "max_sequence_length", "64");
   ffsv_config_set(cfg, "max_tokens_per_batch", "16");
   ffsv_config_set(cfg, "kv_cache_dtype", "float32");
+  /* observe the controller through ffsv_metrics_dump below */
+  ffsv_config_set(cfg, "telemetry", "true");
 
-  void *pair = ffsv_spec_create(cfg, MODEL_JSON(4), MODEL_JSON(2));
+  void *pair = ffsv_spec_create(cfg, VERIFIER_JSON, DRAFTS_JSON);
   if (!pair) {
     fprintf(stderr, "spec create failed: %s\n", ffsv_last_error());
     return 1;
@@ -43,6 +65,8 @@ int main(int argc, char **argv) {
 
   int32_t prompt[] = {5, 9, 23, 7};
   long g = ffsv_register_request(pair, prompt, 4, 6);
+  /* depth argument 3 = compiled max; generation_config.spec_depth
+   * matches, and the controller adapts each request's depth below it */
   if (g < 0 || ffsv_generate_spec(pair, 3) != 1) {
     fprintf(stderr, "spec generate failed: %s\n", ffsv_last_error());
     return 1;
@@ -55,7 +79,17 @@ int main(int argc, char **argv) {
   }
   printf("spec request %ld ->", g);
   for (int i = 0; i < n && i < 64; i++) printf(" %d", out[i]);
-  printf("\nC spec_infer OK\n");
+  printf("\n");
+  /* the controller's depth/fallback state is part of the metrics
+   * surface — a C host can watch acceptance health without Python */
+  char *snap = ffsv_metrics_dump("json");
+  if (!snap || !strstr(snap, "ffsv_spec_effective_depth")) {
+    fprintf(stderr, "controller metrics missing: %s\n", ffsv_last_error());
+    return 1;
+  }
+  printf("controller metrics present (ffsv_spec_effective_depth)\n");
+  free(snap);
+  printf("C spec_infer OK\n");
   ffsv_release(pair);
   ffsv_release(cfg);
   return 0;

@@ -40,11 +40,29 @@ DEFAULT_PIPELINE_DEPTH = 4
 
 @dataclasses.dataclass
 class GenerationConfig:
-    """Sampling configuration (reference include/flexflow/inference.h:23-33)."""
+    """Sampling + speculation-policy configuration (reference
+    include/flexflow/inference.h:23-33 covers the sampling half; the
+    adaptive-speculation knobs drive serve/spec_controller.py and are
+    settable from embedded C hosts through the ``ffsv`` spec JSON's
+    ``generation_config`` object — see capi_host.llm_create)."""
 
     do_sample: bool = False
     temperature: float = 0.8
     topp: float = 0.6
+    # --- adaptive speculation controller (serve/spec_controller.py) ---
+    # On by default: spec decoding must never lose to incremental — the
+    # controller tunes per-request draft depth from observed acceptance
+    # and parks hopeless requests on the fused incremental decode block
+    # (token-identical output either way; greedy acceptance commits the
+    # verifier's own argmax sequence).
+    adaptive_spec: bool = True
+    spec_depth: int = 0             # 0 = caller's depth / engine max
+    min_spec_depth: int = 1
+    spec_fallback_margin: float = 0.95   # park below this est. speedup
+    spec_recover_margin: float = 1.05    # un-park above this (hysteresis)
+    spec_probe_every: int = 4            # fallback blocks between probes
+    spec_ewma_alpha: float = 0.4
+    spec_draft_cost_ratio: float = 0.0   # 0 = estimate from param bytes
 
 
 @jax.tree_util.register_dataclass

@@ -147,12 +147,77 @@ def _families() -> Dict[str, tuple]:
 class _ServingHost:
     """One compiled serving model + its RequestManager."""
 
-    def __init__(self, model):
+    def __init__(self, model, gen_cfg=None):
         from flexflow_tpu.serve.request_manager import RequestManager
 
         self.model = model
         self.rm = RequestManager()
         self.results: Dict[int, List[int]] = {}
+        # adaptive-speculation / sampling policy parsed from the spec
+        # JSON's "generation_config" (None -> library defaults)
+        self.gen_cfg = gen_cfg
+
+
+# spec-JSON "generation_config" keys -> GenerationConfig fields. Short C
+# -friendly spellings on the wire; the Python dataclass keeps the long
+# names (serve/batch_config.py documents semantics).
+_GEN_CFG_KEYS = {
+    "adaptive": "adaptive_spec",
+    "adaptive_spec": "adaptive_spec",
+    "spec_depth": "spec_depth",
+    "min_spec_depth": "min_spec_depth",
+    "fallback_margin": "spec_fallback_margin",
+    "recover_margin": "spec_recover_margin",
+    "probe_every": "spec_probe_every",
+    "ewma_alpha": "spec_ewma_alpha",
+    "draft_cost_ratio": "spec_draft_cost_ratio",
+    "do_sample": "do_sample",
+    "temperature": "temperature",
+    "topp": "topp",
+}
+
+
+def _parse_generation_config(spec: dict):
+    """Optional ``generation_config`` object -> GenerationConfig (None
+    when absent). Unknown keys AND out-of-range values raise so a C
+    host's typo'd or nonsensical knob cannot silently run a degenerate
+    policy (surfaces via ffsv_last_error)."""
+    raw = spec.get("generation_config")
+    if raw is None:
+        return None
+    from flexflow_tpu.serve.batch_config import GenerationConfig
+
+    unknown = sorted(set(raw) - set(_GEN_CFG_KEYS))
+    if unknown:
+        raise ValueError(f"unknown generation_config keys {unknown}; "
+                         f"have {sorted(_GEN_CFG_KEYS)}")
+    gc = GenerationConfig(**{_GEN_CFG_KEYS[k]: v for k, v in raw.items()})
+    checks = (
+        ("adaptive", isinstance(gc.adaptive_spec, bool), "a boolean"),
+        ("spec_depth", isinstance(gc.spec_depth, int)
+         and gc.spec_depth >= 0, "an int >= 0 (0 = caller's depth)"),
+        ("min_spec_depth", isinstance(gc.min_spec_depth, int)
+         and gc.min_spec_depth >= 1, "an int >= 1"),
+        ("probe_every", isinstance(gc.spec_probe_every, int)
+         and gc.spec_probe_every >= 1, "an int >= 1"),
+        ("ewma_alpha", isinstance(gc.spec_ewma_alpha, (int, float))
+         and 0 < gc.spec_ewma_alpha <= 1, "in (0, 1]"),
+        ("fallback_margin",
+         isinstance(gc.spec_fallback_margin, (int, float))
+         and gc.spec_fallback_margin > 0, "> 0"),
+        ("recover_margin",
+         isinstance(gc.spec_recover_margin, (int, float))
+         and gc.spec_recover_margin >= gc.spec_fallback_margin,
+         ">= fallback_margin (hysteresis)"),
+        ("draft_cost_ratio",
+         isinstance(gc.spec_draft_cost_ratio, (int, float))
+         and gc.spec_draft_cost_ratio >= 0, ">= 0 (0 = estimate)"),
+    )
+    for key, ok, want in checks:
+        if not ok:
+            raise ValueError(
+                f"generation_config.{key} must be {want}")
+    return gc
 
 
 def llm_create(cfg, spec_json: str) -> _ServingHost:
@@ -160,7 +225,10 @@ def llm_create(cfg, spec_json: str) -> _ServingHost:
 
     ``{"family": "llama", "model_config": {<family Config kwargs>},
        "mode": "inc" | "spec" | "tree",
-       "weights_npz": "<path>" (optional — default is seeded init)}``
+       "weights_npz": "<path>" (optional — default is seeded init),
+       "generation_config": {<adaptive speculation / sampling knobs>}
+       (optional — see _GEN_CFG_KEYS; e.g. {"adaptive": true,
+       "spec_depth": 6, "min_spec_depth": 1, "fallback_margin": 0.95})}``
 
     The reference counterpart chains flexflow_model_create, the per-op
     builder calls, FileDataLoader weight load and init_operators_inference
@@ -170,6 +238,7 @@ def llm_create(cfg, spec_json: str) -> _ServingHost:
     from flexflow_tpu.ffconst import CompMode, InferenceMode
 
     spec = json.loads(spec_json)
+    gen_cfg = _parse_generation_config(spec)
     family = spec.get("family", "llama")
     if family not in _families():
         raise ValueError(f"unknown model family {family!r}; "
@@ -194,7 +263,7 @@ def llm_create(cfg, spec_json: str) -> _ServingHost:
         from flexflow_tpu.training.checkpoint import load_weights_npz
 
         load_weights_npz(weights, model)
-    return _ServingHost(model)
+    return _ServingHost(model, gen_cfg=gen_cfg)
 
 
 # ---------------------------------------------------------------------------
@@ -225,8 +294,8 @@ class _SpecHost(_ServingHost):
     """Verifier + draft SSMs (reference spec_infer main: one LLM, one or
     more SSMs driven through RequestManager)."""
 
-    def __init__(self, model, ssms):
-        super().__init__(model)
+    def __init__(self, model, ssms, gen_cfg=None):
+        super().__init__(model, gen_cfg=gen_cfg)
         self.ssms = ssms
 
 
@@ -236,14 +305,27 @@ def spec_create(cfg, verifier_json: str, draft_json: str) -> _SpecHost:
     TREE_VERIFY mode and its SSMs in BEAM_SEARCH mode). Both specs use
     the llm_create JSON schema; a draft whose family/dims truncate the
     verifier's shares its shallow weights automatically (per-layer-name
-    seeded init), matching the bench's truncation-draft construction."""
+    seeded init), matching the bench's truncation-draft construction.
+
+    Multi-SSM: ``draft_json`` may instead be ``{"ssms": [<spec>, ...]}``
+    — one draft model per entry, all proposing into one merged token
+    tree per round (the reference's multi-SSM SpecInfer configuration).
+    The verifier spec's ``generation_config`` (llm_create schema) carries
+    the pair-level adaptive-speculation policy; its ``spec_depth``
+    overrides the ffsv_generate_spec argument when set."""
     v = dict(json.loads(verifier_json))
     v["mode"] = "tree"
-    d = dict(json.loads(draft_json))
-    d["mode"] = "spec"
+    d = json.loads(draft_json)
+    draft_specs = d["ssms"] if isinstance(d, dict) and "ssms" in d else [d]
+    if not draft_specs:
+        raise ValueError('draft spec "ssms" must name at least one model')
     verifier = llm_create(cfg, json.dumps(v))
-    draft = llm_create(cfg, json.dumps(d))
-    return _SpecHost(verifier.model, [draft.model])
+    drafts = []
+    for ds in draft_specs:
+        ds = dict(ds)
+        ds["mode"] = "spec"
+        drafts.append(llm_create(cfg, json.dumps(ds)).model)
+    return _SpecHost(verifier.model, drafts, gen_cfg=verifier.gen_cfg)
 
 
 def generate_spec(host: _SpecHost, spec_depth: int) -> int:
@@ -251,11 +333,15 @@ def generate_spec(host: _SpecHost, spec_depth: int) -> int:
     flexflow_model_generate on a spec-configured model). Returns the
     number of finished requests. ``spec_depth`` must be >= 1 — the
     RequestManager treats falsy depths as "use the maximum", which would
-    silently invert a C caller's 0-means-off intent."""
+    silently invert a C caller's 0-means-off intent. The spec JSON's
+    ``generation_config`` (held on the host) supplies the adaptive
+    depth-controller policy; its ``spec_depth`` field, when set,
+    overrides this argument."""
     if int(spec_depth) < 1:
         raise ValueError(f"spec_depth must be >= 1, got {spec_depth}")
     results = host.rm.generate_spec_infer(host.model, host.ssms,
-                                          spec_depth=int(spec_depth))
+                                          spec_depth=int(spec_depth),
+                                          generation_config=host.gen_cfg)
     for r in results:
         host.results[r.guid] = [int(t) for t in r.output_tokens]
     return len(results)

@@ -90,6 +90,30 @@ def _forward_tokens(model, params, state, tokens, positions, start_pos,
                              kv_contiguous=True)
 
 
+def _adapt_depth_rule(adapt, act_i, n_acc, depth_v, alive, min_depth,
+                      max_depth):
+    """Adaptive-mode in-block policy shared by the three fused engines'
+    while_loop bodies (a no-op when the host ran the block statically):
+
+    * depth adaptation between rounds — grow on a full accept, shrink on
+      a zero accept, hold otherwise, bounded by [min_depth, compiled
+      depth]; the host re-anchors from its EWMA cost model at the block
+      boundary;
+    * give-up — a row already AT the floor that still accepts nothing
+      exits the block, so a collapsed draft costs at most the shrink
+      path (~depth rounds) before the host parks it on incremental
+      decoding, never a whole max_rounds block.
+
+    Returns (depth_v, alive)."""
+    give_up = adapt & act_i & (n_acc == 0) & (depth_v == min_depth)
+    alive = alive & ~give_up
+    grown = jnp.where(n_acc >= depth_v, depth_v + 1,
+                      jnp.where(n_acc == 0, depth_v - 1, depth_v))
+    depth_v = jnp.where(adapt & act_i,
+                        jnp.clip(grown, min_depth, max_depth), depth_v)
+    return depth_v, alive
+
+
 def make_draft_chain(model, compute_dtype, depth: int):
     """Build a fused greedy draft-chain program for one SSM.
 
@@ -306,10 +330,13 @@ class MultiSpecEngine:
                 jnp.asarray(depth_of),
                 jnp.asarray(np.broadcast_to(anc, (R, Tp, Tp))))
 
-    def _draft(self, j, params, state, tks, nblk, base, active, rng):
+    def _draft(self, j, params, state, tks, nblk, base, active, rng, d_run):
         """Catch-up + chain for SSM j. tks [R, d+1] = last round's accepted
         block (count nblk, first token at position base). Returns
-        (state, chain [R, d])."""
+        (state, chain [R, d]). ``d_run`` (device scalar, 1..depth) bounds
+        the chain steps actually executed this round — the spec
+        controller's early-exit; columns past it stay zero and are capped
+        off in acceptance."""
         d = self.depth
         R = tks.shape[0]
         ssm = self.ssms[j]
@@ -323,22 +350,25 @@ class MultiSpecEngine:
             out, jnp.maximum(nblk - 1, 0)[:, None], axis=1)[:, 0]
         t = t.astype(jnp.int32)
         r_pos = base + nblk - 1                     # root position
-        chain0 = t
+        chain0 = jnp.zeros((R, d), jnp.int32).at[:, 0].set(t)
 
-        def body(carry, i):
-            state, t, p = carry
+        def cond(carry):
+            return carry[0] < d_run - 1
+
+        def body(carry):
+            i, state, t, p, chain = carry
             out, state = _forward_tokens(
                 ssm, params, state, t[:, None], p[:, None], p,
                 active.astype(jnp.int32), active,
                 jax.random.fold_in(rng, 1 + i), self._compute_dtype)
             nxt = out[:, 0].astype(jnp.int32)
-            return (state, nxt, p + 1), nxt
+            chain = jax.lax.dynamic_update_slice(chain, nxt[:, None],
+                                                 (0, i + 1))
+            return i + 1, state, nxt, p + 1, chain
 
-        (state, _, _), rest = jax.lax.scan(
-            body, (state, t, r_pos + 1), jnp.arange(d - 1))
-        chain = jnp.concatenate([chain0[:, None], jnp.transpose(rest)],
-                                axis=1)             # [R, d]
-        return state, chain
+        (_, state, _, _, chain) = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), state, t, r_pos + 1, chain0))
+        return state, chain                         # [R, d]
 
     def _commit(self, llm_state, best_j, n_acc, r_pos, active):
         """cache[r, :, r_pos+1+i] <- cache[r, :, r_pos+1+best_j*d+i] for
@@ -368,18 +398,22 @@ class MultiSpecEngine:
                 "kv_cache": {"k": move(st["k"]), "v": move(st["v"])}}
 
     def _round(self, llm_params, llm_state, ssm_ps, ssm_states, tks, nblk,
-               base, active, rng):
+               base, active, rng, depth_r):
         d, B = self.depth, len(self.ssms)
         R = tks.shape[0]
         T = 1 + B * d
         # (sequence-length safety: _block_impl's live_mask gates entry)
         r_pos = base + nblk - 1
+        # deepest active row bounds the draft steps this round (the tree
+        # topology/verify width stay compile-time static; only the cheap
+        # draft-chain steps early-exit)
+        d_run = jnp.max(jnp.where(active, depth_r, 1))
 
         chains = []
         for j in range(B):
             ssm_states[j], chain = self._draft(
                 j, ssm_ps[j], ssm_states[j], tks, nblk, base, active,
-                jax.random.fold_in(rng, 100 + j))
+                jax.random.fold_in(rng, 100 + j), d_run)
             chains.append(chain)
 
         # --- verify: root + B chains as a constant-topology tree ---
@@ -408,10 +442,13 @@ class MultiSpecEngine:
         for j in range(B):
             pred = jnp.concatenate(
                 [o[:, :1], o[:, 1 + j * d: j * d + d]], axis=1)  # [R, d]
-            match = (chains[j] == pred).astype(jnp.int32)
             # longest matching prefix = index of the first mismatch
             # (argmin of [match, 0] — cumprod lowers to a slow O(d^2)
-            # reduce-window on some backends)
+            # reduce-window on some backends); positions past the row's
+            # controller depth count as mismatches, so n_acc <= depth_r
+            match = ((chains[j] == pred)
+                     & (jnp.arange(d)[None, :] < depth_r[:, None])
+                     ).astype(jnp.int32)
             n_js.append(jnp.argmin(
                 jnp.pad(match, ((0, 0), (0, 1))), axis=1).astype(jnp.int32))
         n_mat = jnp.stack(n_js, axis=1)             # [R, B]
@@ -443,17 +480,21 @@ class MultiSpecEngine:
         B = len(self.ssms)
         ssm_ps = [rest[2 * i] for i in range(B)]
         ssm_states = [rest[2 * i + 1] for i in range(B)]
-        (tok, pos, active, n_rounds, remaining) = rest[2 * B:]
+        (tok, pos, active, n_rounds, remaining, depth0, min_depth,
+         adaptive) = rest[2 * B:]
         R = tok.shape[0]
         d = self.depth
         max_seq = self.llm.config.max_sequence_length
         rng0 = jax.random.fold_in(self._rng_const, pos.sum())
-        packed0 = jnp.full((R, self.max_rounds, d + 2), 0, jnp.int32)
+        # packed [R, max_rounds, d+3]: chain ++ bonus ++ n_acc ++ depth
+        packed0 = jnp.full((R, self.max_rounds, d + 3), 0, jnp.int32)
         packed0 = packed0.at[:, :, d + 1].set(-1)
+        packed0 = packed0.at[:, :, d + 2].set(-1)
         # call-boundary invariant: accepted block = just the pending root
         tks0 = jnp.zeros((R, d + 1), jnp.int32).at[:, 0].set(tok)
         nblk0 = jnp.ones((R,), jnp.int32)
         base0 = pos
+        adapt = adaptive > 0
 
         Tp = self.tree_width
 
@@ -464,54 +505,73 @@ class MultiSpecEngine:
             return ((remaining > 0) & (r_pos + Tp <= max_seq - 1))
 
         def cond(carry):
-            i, _ls, _ss, _tks, nblk, base, remaining, act, _p = carry
+            (i, _ls, _ss, _tks, nblk, base, remaining, act, _d, alive,
+             _p) = carry
             return (i < n_rounds) & jnp.any(
-                act & live_mask(base, nblk, remaining))
+                act & live_mask(base, nblk, remaining) & alive)
 
         def body(carry):
             (i, llm_state, ssm_states, tks, nblk, base, remaining, act,
-             packed) = carry
-            act_i = act & live_mask(base, nblk, remaining)
+             depth_v, alive, packed) = carry
+            act_i = act & live_mask(base, nblk, remaining) & alive
             (llm_state, ssm_states, blk, new_nblk, new_base, chain, n_acc,
              bonus) = self._round(
                 llm_params, llm_state, ssm_ps, list(ssm_states), tks, nblk,
-                base, act_i, jax.random.fold_in(rng0, i))
+                base, act_i, jax.random.fold_in(rng0, i), depth_v)
             tks = jnp.where(act_i[:, None], blk, tks)
             nblk = jnp.where(act_i, new_nblk, nblk)
             base = jnp.where(act_i, new_base, base)
             remaining = remaining - jnp.where(act_i, n_acc + 1, 0)
             row = jnp.concatenate(
                 [chain, bonus[:, None],
-                 jnp.where(act_i, n_acc, -1)[:, None]], axis=1)
+                 jnp.where(act_i, n_acc, -1)[:, None],
+                 jnp.where(act_i, depth_v, -1)[:, None]], axis=1)
             packed = jax.lax.dynamic_update_slice(
                 packed, row[:, None, :], (0, i, 0))
+            depth_v, alive = _adapt_depth_rule(adapt, act_i, n_acc,
+                                               depth_v, alive, min_depth,
+                                               d)
             return (i + 1, llm_state, tuple(ssm_states), tks, nblk, base,
-                    remaining, act, packed)
+                    remaining, act, depth_v, alive, packed)
 
-        (_, llm_state, ssm_states, _, _, _, _, _, packed) = \
+        (_, llm_state, ssm_states, _, _, _, _, _, _, _, packed) = \
             jax.lax.while_loop(
                 cond, body,
                 (jnp.int32(0), llm_state, tuple(ssm_states), tks0, nblk0,
-                 base0, remaining, active, packed0))
+                 base0, remaining, active, depth0, active, packed0))
         return (llm_state, tuple(ssm_states), packed)
 
     def run_block(self, tok: np.ndarray, pos: np.ndarray, active: np.ndarray,
-                  n_rounds: int, remaining: Optional[np.ndarray] = None
-                  ) -> Tuple[np.ndarray, np.ndarray]:
-        """Run up to ``n_rounds`` fused tree rounds. Returns (toks, n_acc):
-        toks[r, k] holds round k's [chain tokens (depth), bonus]; the
-        committed tokens are ``toks[r, k, :n_acc[r, k]]`` plus the bonus at
-        the FIXED index ``toks[r, k, depth]``; n_acc == -1 marks an idle
-        round."""
+                  n_rounds: int, remaining: Optional[np.ndarray] = None,
+                  depth: Optional[np.ndarray] = None,
+                  min_depth: int = 1
+                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Run up to ``n_rounds`` fused tree rounds. Returns
+        (toks, n_acc, depth_used): toks[r, k] holds round k's [chain
+        tokens (depth), bonus]; the committed tokens are
+        ``toks[r, k, :n_acc[r, k]]`` plus the bonus at the FIXED index
+        ``toks[r, k, depth]``; n_acc == -1 marks an idle round.
+        ``depth``/``min_depth``/``depth_used`` follow the
+        SpecChainEngine.run_block contract (per-row effective depth +
+        give-up, no retrace; the tree topology and verify width stay
+        static — only draft-chain steps early-exit and acceptance caps
+        per row; depth=None = static legacy behavior)."""
         n_rounds = min(int(n_rounds), self.max_rounds)
         if remaining is None:
             remaining = np.full(tok.shape, np.iinfo(np.int32).max // 2,
                                 np.int32)
+        adaptive = depth is not None
+        if depth is None:
+            depth = np.full(tok.shape, self.depth, np.int32)
+        depth = np.clip(np.asarray(depth, np.int32), 1, self.depth)
         args = [self.llm.params, self.llm.op_state]
         for s in self.ssms:
             args += [s.params, s.op_state]
         args += [jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(active),
-                 jnp.int32(n_rounds), jnp.asarray(remaining, jnp.int32)]
+                 jnp.int32(n_rounds), jnp.asarray(remaining, jnp.int32),
+                 jnp.asarray(depth),
+                 jnp.int32(max(1, min(int(min_depth), self.depth))),
+                 jnp.int32(int(adaptive))]
         tel = _resolve_tel(self.telemetry)
         t0 = time.perf_counter()
         llm_state, ssm_states, packed = self._block(*args)
@@ -521,9 +581,9 @@ class MultiSpecEngine:
         packed = np.asarray(packed)
         if tel is not None:     # the np readback above is the device fence
             tel.record_spec_block(time.perf_counter() - t0,
-                                  packed[:, :, -1], self.depth,
-                                  self.tree_width)
-        return packed[:, :, :-1], packed[:, :, -1]
+                                  packed[:, :, -2], self.depth,
+                                  self.tree_width, depths=packed[:, :, -1])
+        return packed[:, :, :-2], packed[:, :, -2], packed[:, :, -1]
 
 
 class SpecChainEngine:
@@ -553,24 +613,38 @@ class SpecChainEngine:
         self._rng_const = jax.random.PRNGKey(llm.config.seed)
 
     def _round(self, llm_params, llm_state, ssm_params, ssm_state, tok, pos,
-               rng, active):
+               rng, active, depth_r):
         d = self.depth
         num = active.astype(jnp.int32)
+        R = tok.shape[0]
+        # the deepest active row's controller depth bounds the draft trip
+        # count this round — one compiled program serves every mixed-depth
+        # batch; shallower rows just stop counting matches at their own
+        # depth (the spec controller's no-retrace contract)
+        d_run = jnp.max(jnp.where(active, depth_r, 1))
 
-        # --- draft chain: depth+1 steps, last one only back-fills KV ---
-        def draft_body(carry, i):
-            state, t, p = carry
+        # --- draft chain: d_run+1 steps, last one only back-fills KV ---
+        def draft_cond(carry):
+            return carry[0] < d_run + 1
+
+        def draft_body(carry):
+            i, state, t, p, chain = carry
             out, state = _forward_tokens(
                 self.ssm, ssm_params, state, t[:, None], p[:, None], p, num,
                 active, jax.random.fold_in(rng, i), self._compute_dtype)
             nxt = out[:, 0].astype(jnp.int32)
-            return (state, nxt, p + 1), nxt
+            chain = jax.lax.dynamic_update_slice(chain, nxt[:, None], (0, i))
+            return i + 1, state, nxt, p + 1, chain
 
-        (ssm_state, _, _), chain = jax.lax.scan(
-            draft_body, (ssm_state, tok, pos), jnp.arange(d + 1))
-        chain = jnp.transpose(chain)[:, :d]                     # [R, d]
+        (_, ssm_state, _, _, chain) = jax.lax.while_loop(
+            draft_cond, draft_body,
+            (jnp.int32(0), ssm_state, tok, pos,
+             jnp.zeros((R, d + 1), jnp.int32)))
+        chain = chain[:, :d]                                    # [R, d]
 
         # --- verify: one causal pass over [pending, chain...] ---
+        # (static width d+1: undrafted tail columns hold zeros whose
+        # staged KV is overwritten by later rounds, exactly like padding)
         vtokens = jnp.concatenate([tok[:, None], chain], axis=1)  # [R, d+1]
         vpos = pos[:, None] + jnp.arange(d + 1)[None, :]
         out, llm_state = _forward_tokens(
@@ -581,7 +655,11 @@ class SpecChainEngine:
 
         # --- greedy acceptance: longest prefix where chain matches ---
         # (= index of the first mismatch; see MultiSpecEngine on cumprod)
-        match = (chain == a[:, :d]).astype(jnp.int32)
+        # capped per row at the controller depth: positions past depth_r
+        # count as mismatches, so n_acc <= depth_r
+        match = ((chain == a[:, :d])
+                 & (jnp.arange(d)[None, :] < depth_r[:, None])
+                 ).astype(jnp.int32)
         n_acc = jnp.argmin(jnp.pad(match, ((0, 0), (0, 1))),
                            axis=1).astype(jnp.int32)            # [R] in [0,d]
         bonus = jnp.take_along_axis(a, n_acc[:, None], axis=1)[:, 0]
@@ -590,17 +668,21 @@ class SpecChainEngine:
         return llm_state, ssm_state, new_tok, new_pos, a, n_acc
 
     def _block_impl(self, llm_params, llm_state, ssm_params, ssm_state, tok,
-                    pos, active, n_rounds, remaining):
+                    pos, active, n_rounds, remaining, depth0, min_depth,
+                    adaptive):
         R = tok.shape[0]
         d = self.depth
         max_seq = self.llm.config.max_sequence_length
         rng0 = jax.random.fold_in(self._rng_const, pos.sum())
-        # packed output: [R, max_rounds, d+2] = verifier tokens ++ n_acc —
-        # the host reads ONE buffer per block (each separate device->host
-        # read costs a full round trip under remote runtimes). n_acc = -1
-        # marks a round where the request was already done (no tokens).
-        packed0 = jnp.full((R, self.max_rounds, d + 2), 0, jnp.int32)
+        # packed output: [R, max_rounds, d+3] = verifier tokens ++ n_acc
+        # ++ effective depth — the host reads ONE buffer per block (each
+        # separate device->host read costs a full round trip under remote
+        # runtimes). n_acc = -1 marks a round where the request was
+        # already done (no tokens); depth = -1 likewise.
+        packed0 = jnp.full((R, self.max_rounds, d + 3), 0, jnp.int32)
         packed0 = packed0.at[:, :, d + 1].set(-1)
+        packed0 = packed0.at[:, :, d + 2].set(-1)
+        adapt = adaptive > 0
 
         def live_mask(pos, remaining):
             # a request drafts this round only while it still owes tokens
@@ -608,34 +690,45 @@ class SpecChainEngine:
             return active & (remaining > 0) & (pos + d < max_seq)
 
         def cond(carry):
-            i, _ls, _ss, _t, pos, remaining, _p = carry
-            return (i < n_rounds) & jnp.any(live_mask(pos, remaining))
+            i, _ls, _ss, _t, pos, remaining, _d, alive, _p = carry
+            return (i < n_rounds) & jnp.any(live_mask(pos, remaining)
+                                            & alive)
 
         def body(carry):
-            i, llm_state, ssm_state, tok, pos, remaining, packed = carry
-            act_i = live_mask(pos, remaining)
+            (i, llm_state, ssm_state, tok, pos, remaining, depth_v, alive,
+             packed) = carry
+            act_i = live_mask(pos, remaining) & alive
             llm_state, ssm_state, ntok, npos, a, n_acc = self._round(
                 llm_params, llm_state, ssm_params, ssm_state, tok, pos,
-                jax.random.fold_in(rng0, i), act_i)
+                jax.random.fold_in(rng0, i), act_i, depth_v)
             tok = jnp.where(act_i, ntok, tok)
             pos = jnp.where(act_i, npos, pos)
             remaining = remaining - jnp.where(act_i, n_acc + 1, 0)
             row = jnp.concatenate(
-                [a, jnp.where(act_i, n_acc, -1)[:, None]], axis=1)
+                [a, jnp.where(act_i, n_acc, -1)[:, None],
+                 jnp.where(act_i, depth_v, -1)[:, None]], axis=1)
             packed = jax.lax.dynamic_update_slice(
                 packed, row[:, None, :], (0, i, 0))
-            return i + 1, llm_state, ssm_state, tok, pos, remaining, packed
+            depth_v, alive = _adapt_depth_rule(adapt, act_i, n_acc,
+                                               depth_v, alive, min_depth,
+                                               d)
+            return (i + 1, llm_state, ssm_state, tok, pos, remaining,
+                    depth_v, alive, packed)
 
-        (_, llm_state, ssm_state, _, _, _, packed) = jax.lax.while_loop(
-            cond, body, (jnp.int32(0), llm_state, ssm_state, tok, pos,
-                         remaining, packed0))
+        (_, llm_state, ssm_state, _, _, _, _, _, packed) = \
+            jax.lax.while_loop(
+                cond, body, (jnp.int32(0), llm_state, ssm_state, tok, pos,
+                             remaining, depth0, active, packed0))
         return llm_state, ssm_state, packed
 
     def run_block(self, tok: np.ndarray, pos: np.ndarray, active: np.ndarray,
                   n_rounds: int,
-                  remaining: Optional[np.ndarray] = None
-                  ) -> Tuple[np.ndarray, np.ndarray]:
-        """Run up to ``n_rounds`` (<= max_rounds) rounds; returns (a, n_acc).
+                  remaining: Optional[np.ndarray] = None,
+                  depth: Optional[np.ndarray] = None,
+                  min_depth: int = 1
+                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Run up to ``n_rounds`` (<= max_rounds) rounds; returns
+        (a, n_acc, depth_used).
 
         a[r, k] is round k's verifier outputs [depth+1]; the committed
         tokens for slot r in round k are ``a[r, k, :n_acc[r, k] + 1]``;
@@ -644,24 +737,44 @@ class SpecChainEngine:
         loop exits early once every request has drafted its budget (or hit
         the KV-cache end), so one call normally finishes a whole request
         batch. Updates both models' op_state.
+
+        ``depth[r]`` (None = static legacy behavior: the compiled depth,
+        no in-block adaptation) bounds row r's EFFECTIVE draft depth for
+        the first round — the block is compiled once at the max depth and
+        drafting early-exits at the round's deepest active row, so a
+        mixed batch runs different depths in one round with no retrace.
+        Between rounds the device grows/shrinks each row's depth (full
+        accept -> +1, zero accept -> -1, clipped to [min_depth, depth])
+        and a row that accepts nothing while already at the floor EXITS
+        the block (give-up) so the host controller can park it;
+        depth_used[r, k] reports the bound each round actually ran under
+        (-1 on idle rounds) so the host can attribute its acceptance
+        observations.
         """
         n_rounds = min(int(n_rounds), self.max_rounds)
         if remaining is None:
             remaining = np.full(tok.shape, np.iinfo(np.int32).max // 2,
                                 np.int32)
+        adaptive = depth is not None
+        if depth is None:
+            depth = np.full(tok.shape, self.depth, np.int32)
+        depth = np.clip(np.asarray(depth, np.int32), 1, self.depth)
+        min_depth = max(1, min(int(min_depth), self.depth))
         tel = _resolve_tel(self.telemetry)
         t0 = time.perf_counter()
         (self.llm.op_state, self.ssm.op_state, packed) = self._block(
             self.llm.params, self.llm.op_state, self.ssm.params,
             self.ssm.op_state, jnp.asarray(tok), jnp.asarray(pos),
             jnp.asarray(active), jnp.int32(n_rounds),
-            jnp.asarray(remaining, dtype=jnp.int32))
+            jnp.asarray(remaining, dtype=jnp.int32),
+            jnp.asarray(depth), jnp.int32(min_depth),
+            jnp.int32(int(adaptive)))
         packed = np.asarray(packed)
         if tel is not None:     # the np readback above is the device fence
             tel.record_spec_block(time.perf_counter() - t0,
-                                  packed[:, :, -1], self.depth,
-                                  self.depth + 1)
-        return packed[:, :, :-1], packed[:, :, -1]
+                                  packed[:, :, -2], self.depth,
+                                  self.depth + 1, depths=packed[:, :, -1])
+        return packed[:, :, :-2], packed[:, :, -2], packed[:, :, -1]
 
 
 class BeamSpecEngine:
@@ -730,12 +843,16 @@ class BeamSpecEngine:
         return cum, tok, par
 
     def _round(self, llm_params, llm_state, ssm_params, ssm_state, tks,
-               nblk, base, active, rng):
+               nblk, base, active, rng, depth_r):
         from flexflow_tpu.serve.batch_config import TreeBatchMeta
 
         d, W, T, Tp = self.depth, self.width, self.T, self.tree_width
         R = tks.shape[0]
         r_pos = base + nblk - 1
+        # deepest active row's controller depth: beam levels past it are
+        # skipped entirely (lax.cond — the node layout stays compile-time
+        # static, the level's tree forward just doesn't execute)
+        d_run = jnp.max(jnp.where(active, depth_r, 1))
 
         # --- catch-up + root expansion (one causal pass, width d+1) ---
         pos = base[:, None] + jnp.arange(d + 1)[None, :]
@@ -757,39 +874,9 @@ class BeamSpecEngine:
         anc = anc.at[:, 0, 0].set(True)
         positions = r_pos[:, None] + self._depth_of[None, :]
 
-        cum = jnp.zeros((R, W), jnp.float32)
-        for t in range(d):
-            if t == 0:
-                probs = root_out[:, None, :W]          # [R, 1, W]
-                ids = root_out[:, None, W:2 * W]
-                f0 = 0
-                par_of_cand = jnp.zeros((R, W), jnp.int32)
-                cand = jnp.log(jnp.maximum(
-                    probs[:, 0].astype(jnp.float32), 1e-20))
-                ids_flat = ids[:, 0]
-                par_flat = par_of_cand
-            else:
-                meta = TreeBatchMeta(
-                    tokens=tokens, positions=positions, parent=parent,
-                    ancestor=anc, start_pos=r_pos,
-                    num_nodes=jnp.where(active, 1 + t * W, 0)
-                    .astype(jnp.int32), active=active)
-                out, ssm_state = forward_with_meta(
-                    self.ssm, ssm_params, ssm_state, meta,
-                    jax.random.fold_in(rng, 1 + t), self._compute_dtype,
-                    kv_contiguous=True)               # [R, Tp, 2W]
-                f0 = 1 + (t - 1) * W
-                probs = out[:, f0:f0 + W, :W].astype(jnp.float32)
-                ids = out[:, f0:f0 + W, W:2 * W]
-                # candidate (fi, j) -> flat fi*W + j, frontier-major like
-                # the host's stable sort order
-                cand = (cum[:, :, None]
-                        + jnp.log(jnp.maximum(probs, 1e-20))
-                        ).reshape(R, W * W)
-                ids_flat = ids.reshape(R, W * W)
-                par_flat = jnp.broadcast_to(
-                    (f0 + jnp.arange(W))[None, :, None], (R, W, W)
-                ).reshape(R, W * W)
+        def place_level(t, carry, cand, ids_flat, par_flat):
+            """top-W select + static-slot node placement for level t."""
+            ssm_state, tokens, parent, anc, cum = carry
             cum, tok_new, par_new = self._select(cand, ids_flat, par_flat)
             lvl0 = 1 + t * W
             tokens = jax.lax.dynamic_update_slice(tokens, tok_new,
@@ -803,6 +890,54 @@ class BeamSpecEngine:
                                      dtype=bool)[None]
             anc = jax.lax.dynamic_update_slice(
                 anc, par_rows | selfhot, (0, lvl0, 0))
+            return (ssm_state, tokens, parent, anc, cum)
+
+        def expand_level(t, carry):
+            """Stage the accumulated tree on the draft and grow level t
+            (t >= 1; level 0 reuses the catch-up pass's root expansion)."""
+            ssm_state, tokens, parent, anc, cum = carry
+            meta = TreeBatchMeta(
+                tokens=tokens, positions=positions, parent=parent,
+                ancestor=anc, start_pos=r_pos,
+                num_nodes=jnp.where(active, 1 + t * W, 0)
+                .astype(jnp.int32), active=active)
+            out, ssm_state = forward_with_meta(
+                self.ssm, ssm_params, ssm_state, meta,
+                jax.random.fold_in(rng, 1 + t), self._compute_dtype,
+                kv_contiguous=True)               # [R, Tp, 2W]
+            f0 = 1 + (t - 1) * W
+            probs = out[:, f0:f0 + W, :W].astype(jnp.float32)
+            ids = out[:, f0:f0 + W, W:2 * W]
+            # candidate (fi, j) -> flat fi*W + j, frontier-major like
+            # the host's stable sort order
+            cand = (cum[:, :, None]
+                    + jnp.log(jnp.maximum(probs, 1e-20))
+                    ).reshape(R, W * W)
+            ids_flat = ids.reshape(R, W * W)
+            par_flat = jnp.broadcast_to(
+                (f0 + jnp.arange(W))[None, :, None], (R, W, W)
+            ).reshape(R, W * W)
+            return place_level(t, (ssm_state, tokens, parent, anc, cum),
+                               cand, ids_flat, par_flat)
+
+        cum = jnp.zeros((R, W), jnp.float32)
+        carry = (ssm_state, tokens, parent, anc, cum)
+        # level 0 always runs (d_run >= 1): candidates come straight from
+        # the catch-up pass's packed root expansion
+        carry = place_level(
+            0, carry,
+            jnp.log(jnp.maximum(root_out[:, :W].astype(jnp.float32),
+                                1e-20)),
+            root_out[:, W:2 * W], jnp.zeros((R, W), jnp.int32))
+        for t in range(1, d):
+            # controller early-exit: levels past the round's deepest
+            # active row skip their tree forward entirely (their static
+            # node slots keep zeros, which the capped acceptance walk
+            # below never reaches)
+            carry = jax.lax.cond(d_run > t,
+                                 lambda c, t=t: expand_level(t, c),
+                                 lambda c: c, carry)
+        (ssm_state, tokens, parent, anc, cum) = carry
 
         # --- verify the whole tree on the LLM ---
         meta_v = TreeBatchMeta(
@@ -826,8 +961,9 @@ class BeamSpecEngine:
             tok_lvl = jax.lax.dynamic_slice(tokens, (0, lvl0), (R, W))
             par_lvl = jax.lax.dynamic_slice(parent, (0, lvl0), (R, W))
             want = jnp.take_along_axis(o, cur[:, None], axis=1)[:, 0]
+            # depth_r caps the accepted path per row (controller contract)
             ok = ((par_lvl == cur[:, None]) & (tok_lvl == want[:, None])
-                  & alive[:, None])
+                  & alive[:, None] & (depth_r > t)[:, None])
             has = jnp.any(ok, axis=1)
             nxt = lvl0 + jnp.argmax(ok, axis=1).astype(jnp.int32)
             path = path.at[:, t].set(jnp.where(has, nxt, 0))
@@ -876,34 +1012,39 @@ class BeamSpecEngine:
                 "kv_cache": {"k": move(st["k"]), "v": move(st["v"])}}
 
     def _block_impl(self, llm_params, llm_state, ssm_params, ssm_state,
-                    tok, pos, active, n_rounds, remaining):
+                    tok, pos, active, n_rounds, remaining, depth0,
+                    min_depth, adaptive):
         R = tok.shape[0]
         d = self.depth
         max_seq = self.llm.config.max_sequence_length
         Tp = self.tree_width
         rng0 = jax.random.fold_in(self._rng_const, pos.sum())
-        packed0 = jnp.full((R, self.max_rounds, d + 2), 0, jnp.int32)
+        packed0 = jnp.full((R, self.max_rounds, d + 3), 0, jnp.int32)
         packed0 = packed0.at[:, :, d + 1].set(-1)
+        packed0 = packed0.at[:, :, d + 2].set(-1)
         tks0 = jnp.zeros((R, d + 1), jnp.int32).at[:, 0].set(tok)
         nblk0 = jnp.ones((R,), jnp.int32)
+        adapt = adaptive > 0
 
         def live_mask(base, nblk, remaining):
             r_pos = base + nblk - 1
             return (remaining > 0) & (r_pos + Tp <= max_seq - 1)
 
         def cond(carry):
-            i, _ls, _ss, _tks, nblk, base, remaining, act, _p = carry
+            (i, _ls, _ss, _tks, nblk, base, remaining, act, _d, alive,
+             _p) = carry
             return (i < n_rounds) & jnp.any(
-                act & live_mask(base, nblk, remaining))
+                act & live_mask(base, nblk, remaining) & alive)
 
         def body(carry):
             (i, llm_state, ssm_state, tks, nblk, base, remaining, act,
-             packed) = carry
-            act_i = act & live_mask(base, nblk, remaining)
+             depth_v, alive, packed) = carry
+            act_i = act & live_mask(base, nblk, remaining) & alive
             (llm_state, ssm_state, blk, new_nblk, new_base, chain, n_acc,
              bonus) = self._round(
                 llm_params, llm_state, ssm_params, ssm_state,
-                tks, nblk, base, act_i, jax.random.fold_in(rng0, i))
+                tks, nblk, base, act_i, jax.random.fold_in(rng0, i),
+                depth_v)
             tks = jnp.where(act_i[:, None], blk, tks)
             nblk = jnp.where(act_i, new_nblk, nblk)
             base = jnp.where(act_i, new_base, base)
@@ -912,40 +1053,55 @@ class BeamSpecEngine:
             # the SpecChainEngine packed contract (committed tokens are
             # row[:n_acc + 1]), so one host driver serves both engines
             row = jnp.concatenate(
-                [blk, jnp.where(act_i, n_acc, -1)[:, None]], axis=1)
+                [blk, jnp.where(act_i, n_acc, -1)[:, None],
+                 jnp.where(act_i, depth_v, -1)[:, None]], axis=1)
             packed = jax.lax.dynamic_update_slice(
                 packed, row[:, None, :], (0, i, 0))
+            depth_v, alive = _adapt_depth_rule(adapt, act_i, n_acc,
+                                               depth_v, alive, min_depth,
+                                               d)
             return (i + 1, llm_state, ssm_state, tks, nblk, base,
-                    remaining, act, packed)
+                    remaining, act, depth_v, alive, packed)
 
-        (_, llm_state, ssm_state, _, _, _, _, _, packed) = \
+        (_, llm_state, ssm_state, _, _, _, _, _, _, _, packed) = \
             jax.lax.while_loop(
                 cond, body,
                 (jnp.int32(0), llm_state, ssm_state, tks0, nblk0, pos,
-                 remaining, active, packed0))
+                 remaining, active, depth0, active, packed0))
         return llm_state, ssm_state, packed
 
     def run_block(self, tok: np.ndarray, pos: np.ndarray,
                   active: np.ndarray, n_rounds: int,
-                  remaining: Optional[np.ndarray] = None
-                  ) -> Tuple[np.ndarray, np.ndarray]:
+                  remaining: Optional[np.ndarray] = None,
+                  depth: Optional[np.ndarray] = None,
+                  min_depth: int = 1
+                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Same packed contract as SpecChainEngine.run_block: the committed
         tokens for slot r in round k are ``a[r, k, :n_acc[r, k] + 1]``
-        (accepted path + bonus); n_acc == -1 marks an idle round."""
+        (accepted path + bonus); n_acc == -1 marks an idle round;
+        depth_used reports each round's per-row depth bound (beam levels
+        past the round's deepest bound skip their staged tree forward via
+        lax.cond — static layout, no retrace)."""
         n_rounds = min(int(n_rounds), self.max_rounds)
         if remaining is None:
             remaining = np.full(tok.shape, np.iinfo(np.int32).max // 2,
                                 np.int32)
+        adaptive = depth is not None
+        if depth is None:
+            depth = np.full(tok.shape, self.depth, np.int32)
+        depth = np.clip(np.asarray(depth, np.int32), 1, self.depth)
         tel = _resolve_tel(self.telemetry)
         t0 = time.perf_counter()
         (self.llm.op_state, self.ssm.op_state, packed) = self._block(
             self.llm.params, self.llm.op_state, self.ssm.params,
             self.ssm.op_state, jnp.asarray(tok), jnp.asarray(pos),
             jnp.asarray(active), jnp.int32(n_rounds),
-            jnp.asarray(remaining, jnp.int32))
+            jnp.asarray(remaining, jnp.int32), jnp.asarray(depth),
+            jnp.int32(max(1, min(int(min_depth), self.depth))),
+            jnp.int32(int(adaptive)))
         packed = np.asarray(packed)
         if tel is not None:     # the np readback above is the device fence
             tel.record_spec_block(time.perf_counter() - t0,
-                                  packed[:, :, -1], self.depth,
-                                  self.tree_width)
-        return packed[:, :, :-1], packed[:, :, -1]
+                                  packed[:, :, -2], self.depth,
+                                  self.tree_width, depths=packed[:, :, -1])
+        return packed[:, :, :-2], packed[:, :, -2], packed[:, :, -1]

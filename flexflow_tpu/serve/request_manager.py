@@ -479,13 +479,86 @@ class RequestManager:
             drain()
         return done
 
+    # -- adaptive speculation support (serve/spec_controller.py) ----------
+    @staticmethod
+    def _spec_controller(gc: Optional[GenerationConfig], llm, ssms,
+                         engine_depth: int, beam_width: int = 1):
+        """Build the per-request adaptive speculation controller, or None
+        when the policy disables it (then every path behaves exactly like
+        the pre-controller static engine)."""
+        gc = gc or GenerationConfig()
+        if not gc.adaptive_spec:
+            return None, gc
+        from flexflow_tpu.serve.spec_controller import SpecController
+
+        return SpecController.from_generation_config(
+            gc, llm, ssms, engine_depth=engine_depth,
+            beam_width=beam_width), gc
+
+    def _tick_controller(self, ctrl, tel, live):
+        if ctrl is None or tel is None:
+            return
+        stats = ctrl.live_stats(r.guid for r in live)
+        tel.note_spec_controller(stats["ewma_mean"], stats["n_fallback"],
+                                 ctrl.take_new_fallbacks())
+
+    def _partition_spec(self, ctrl, tel, live, roomy, rounds):
+        """Controller partition shared by the two fused scheduler loops
+        (which must stay in sync — see _generate_spec_tree_fused): split
+        the roomy requests into (draftable, parked), feed the controller
+        telemetry gauges, and shrink a pure-probe tick to ONE round (one
+        acceptance sample — minimal probe tax on parked traffic).
+        Returns (draftable, parked, rounds)."""
+        self._tick_controller(ctrl, tel, live)
+        if ctrl is None:
+            return roomy, [], rounds
+        draftable = [req for req in roomy if ctrl.wants_draft(req.guid)]
+        draft_guids = {req.guid for req in draftable}
+        parked = [req for req in roomy if req.guid not in draft_guids]
+        if draftable and all(ctrl.in_fallback(r.guid) for r in draftable):
+            rounds = 1
+        return draftable, parked, rounds
+
+    def _fallback_decode(self, llm_ifm, reqs, R, max_seq, cfg, tel) -> int:
+        """Fused incremental decode for requests the adaptive speculation
+        controller parked in fallback: the same decode-block program
+        generate_incr_decoding drives (verify-consistent width), so a
+        parked request pays exactly the incremental cost and emits the
+        identical greedy tokens. Draft caches are left stale; the prefill
+        cycle heals them if/when the request probes back into drafting."""
+        block = min(max(self._remaining_budget(r, max_seq) for r in reqs),
+                    cfg.decode_block_steps)
+        R_tok = np.zeros((R,), np.int32)
+        pos = np.zeros((R,), np.int32)
+        act = np.zeros((R,), bool)
+        for req in reqs:
+            R_tok[req.slot] = req.tokens[-1]
+            pos[req.slot] = len(req.tokens) - 1
+            act[req.slot] = True
+        block = max(1, min(block, max_seq - 1 - int(pos[act].max())))
+        self._tel_tick(tel, reqs, R, max_seq)
+        t0 = time.perf_counter()
+        toks = llm_ifm.decode_block(R_tok, pos, act, block)
+        if tel is not None:     # decode_block's np readback = fence
+            tel.record_decode_block(time.perf_counter() - t0, block,
+                                    len(reqs), [r.guid for r in reqs])
+        for req in reqs:
+            for j in range(block):
+                req.tokens.append(int(toks[req.slot, j]))
+                if self._finish_if_done(req, max_seq):
+                    break
+            self._note_first_token(req)
+            req.cache_depth = len(req.tokens) - 1
+        return block
+
     # =====================================================================
     # Speculative inference (reference generate_spec_infer :1867)
     # =====================================================================
     def generate_spec_infer(self, llm, ssms: List[Any],
                             spec_depth: Optional[int] = None,
-                            beam_width: Optional[int] = None
-                            ) -> List[GenerationResult]:
+                            beam_width: Optional[int] = None,
+                            generation_config: Optional[GenerationConfig]
+                            = None) -> List[GenerationResult]:
         """LLM verifies token trees proposed by draft SSMs.
 
         Each SSM proposes a depth-``spec_depth`` token tree per request:
@@ -495,7 +568,20 @@ class RequestManager:
         request_manager.cc); the LLM scores all tree nodes in one step; the
         longest root path whose every child matches the verifier's argmax
         is accepted, plus one bonus token.
+
+        ``generation_config`` carries the adaptive-speculation policy
+        (GenerationConfig: on by default). With the controller on, the
+        fused paths tune each request's draft depth from its observed
+        acceptance and park requests whose estimated spec speedup drops
+        below the incremental break-even on the fused incremental decode
+        block (serve/spec_controller.py) — output tokens are identical
+        either way (greedy acceptance commits the verifier's own argmax
+        sequence); only the wall clock changes. ``spec_depth`` stays the
+        compiled maximum; ``generation_config.spec_depth`` (when set)
+        overrides it. The host-stepped debug/beam-merge path runs static.
         """
+        if generation_config is not None and generation_config.spec_depth:
+            spec_depth = generation_config.spec_depth
         widths = [s.config.max_beam_width for s in ssms]
         W = beam_width or max(widths)
         if any(w != W for w in widths):
@@ -513,9 +599,9 @@ class RequestManager:
                 # LAYOUT is compile-time static (frontier = the newest W
                 # nodes), so drafting + verify + accept + commit all run
                 # inside one device while_loop (engine.BeamSpecEngine)
-                return self._generate_spec_chain(llm, ssms[0],
-                                                 spec_depth=spec_depth,
-                                                 beam_width=W)
+                return self._generate_spec_chain(
+                    llm, ssms[0], spec_depth=spec_depth, beam_width=W,
+                    generation_config=generation_config)
             # multi-SSM beams (merged cross-draft trees) and debug dumps
             # run the host tree path: frontier nodes step through the
             # draft as STAGED TREE NODES (no per-beam KV), and the
@@ -534,15 +620,17 @@ class RequestManager:
             # chain's extra KV-backfill draft step saves. On TPU the
             # weight-bound rounds invert that tradeoff and the fused
             # tree engine below wins (~12% per round at 7B geometry).
-            return self._generate_spec_chain(llm, ssms[0],
-                                             spec_depth=spec_depth)
+            return self._generate_spec_chain(
+                llm, ssms[0], spec_depth=spec_depth,
+                generation_config=generation_config)
         if not llm.config.inference_debugging:
             # multi-SSM trees also run fully fused (engine.MultiSpecEngine:
             # all drafts + tree verify + acceptance + KV compaction inside
             # one device while_loop); the host-stepped path below remains
             # for inference_debugging's per-op tensor dumps.
-            return self._generate_spec_tree_fused(llm, ssms,
-                                                  spec_depth=spec_depth)
+            return self._generate_spec_tree_fused(
+                llm, ssms, spec_depth=spec_depth,
+                generation_config=generation_config)
         return self._generate_spec_tree_host(llm, ssms,
                                              spec_depth=spec_depth,
                                              beam_width=1)
@@ -664,17 +752,22 @@ class RequestManager:
 
     def _generate_spec_chain(self, llm, ssm,
                              spec_depth: Optional[int] = None,
-                             beam_width: int = 1
-                             ) -> List[GenerationResult]:
+                             beam_width: int = 1,
+                             generation_config: Optional[GenerationConfig]
+                             = None) -> List[GenerationResult]:
         """Single-SSM speculative decoding with a fused engine: the chain
         engine at beam_width 1, the beam engine (static-layout beam tree
         drafting, engine.BeamSpecEngine) at width > 1.
 
         Each device call runs SPEC_ROUNDS_PER_CALL full rounds (draft +
         verify + accept) via serve/engine.py; the host walks the returned
-        (a, n_acc) blocks, committing ``a[slot, k, :n_acc+1]`` per round and
-        reconciling EOS / length limits (both engines share the packed
-        block contract).
+        (a, n_acc, depth_used) blocks, committing ``a[slot, k, :n_acc+1]``
+        per round and reconciling EOS / length limits (both engines share
+        the packed block contract). With the adaptive controller on
+        (GenerationConfig.adaptive_spec, the default) each request's
+        depth bound comes from its acceptance EWMA, and requests whose
+        estimated spec speedup falls below incremental break-even decode
+        through ``_fallback_decode`` until a probe round recovers them.
         """
         from flexflow_tpu.serve.engine import BeamSpecEngine, SpecChainEngine
 
@@ -688,6 +781,9 @@ class RequestManager:
         R = cfg.max_requests_per_batch
         max_seq = cfg.max_sequence_length
         depth = min(spec_depth or self.max_spec_depth, self.max_spec_depth)
+        ctrl, gc = self._spec_controller(generation_config, llm, [ssm],
+                                         engine_depth=depth,
+                                         beam_width=beam_width)
         if beam_width > 1:
             engine = getattr(llm, "_beam_engine", None)
             if (engine is None or engine.ssm is not ssm
@@ -726,11 +822,16 @@ class RequestManager:
                                           cfg.max_tokens_per_batch)
                 if ifm is ssm_ifm:
                     # Catching the SSM cache up is only useful if the request
-                    # can still draft (a full round of depth+1 KV slots left);
+                    # can still draft (a full round of depth+1 KV slots left
+                    # AND the controller hasn't parked it on incremental —
+                    # healing a parked request's draft cache would be pure
+                    # waste until its probe comes due);
                     # tail tokens go through the single-step fallback anyway.
                     rows = [(slot, toks, sp) for slot, toks, sp in rows
                             if max_seq - len(active[slot].tokens) - 1
-                            >= room_needed]
+                            >= room_needed
+                            and (ctrl is None
+                                 or ctrl.wants_draft(active[slot].guid))]
                 if rows:
                     meta = self._meta_from_rows(R, chunk, rows)
                     self._timed_prefill(ifm, meta, tel, rows, active)
@@ -752,12 +853,16 @@ class RequestManager:
                 # case); cramped requests finish through the single-step
                 # path below. The device loop also guards per request and
                 # exits early once every budget is drafted.
-                draftable = [req for req in live
-                             if max_seq - len(req.tokens) - 1
-                             >= room_needed]
+                roomy = [req for req in live
+                         if max_seq - len(req.tokens) - 1 >= room_needed]
                 cramped = [req for req in live
                            if max_seq - len(req.tokens) - 1 < room_needed]
-                rounds = min(cfg.spec_rounds_per_call, engine.max_rounds)
+                # controller partition: parked requests decode through the
+                # fused incremental block (same cost/tokens as plain
+                # incremental) until their probe round recovers them
+                draftable, parked, rounds = self._partition_spec(
+                    ctrl, tel, live, roomy,
+                    min(cfg.spec_rounds_per_call, engine.max_rounds))
                 if cramped:
                     # cache nearly full: finish remaining tokens one by one
                     # through the non-fused single-step decode path
@@ -778,11 +883,19 @@ class RequestManager:
                             req.ssm_cache_depth.get(0, 0), sp)
                         self._note_first_token(req)
                         self._finish_if_done(req, max_seq)
+                if parked:
+                    self._fallback_decode(llm_ifm, parked, R, max_seq, cfg,
+                                          tel)
+                    for req in parked:
+                        ctrl.note_fallback_block(req.guid)
                 if draftable:
                     tok = np.zeros((R,), np.int32)
                     pos = np.zeros((R,), np.int32)
                     act = np.zeros((R,), bool)
                     remaining = np.zeros((R,), np.int32)
+                    depth_vec = None
+                    if ctrl is not None:
+                        depth_vec = np.full((R,), depth, np.int32)
                     for req in draftable:
                         assert req.cache_depth == len(req.tokens) - 1
                         assert req.ssm_cache_depth.get(0) == len(req.tokens) - 1
@@ -791,21 +904,26 @@ class RequestManager:
                         act[req.slot] = True
                         remaining[req.slot] = self._remaining_budget(req,
                                                                      max_seq)
+                        if ctrl is not None:
+                            depth_vec[req.slot] = ctrl.depth_for(req.guid)
                     self._tel_tick(tel, draftable, R, max_seq)
                     # engines are cached on the llm across managers:
                     # hand THIS manager's explicit telemetry through (a
                     # None keeps the engine on the process-global one)
                     engine.telemetry = self.telemetry
                     t0 = time.perf_counter()
-                    a, n_acc = engine.run_block(tok, pos, act, rounds,
-                                                remaining)
+                    a, n_acc, d_used = engine.run_block(
+                        tok, pos, act, rounds, remaining, depth=depth_vec,
+                        min_depth=gc.min_spec_depth)
                     block_dt = time.perf_counter() - t0
                     for req in draftable:
                         round_events = []
+                        observed = []
                         for k in range(rounds):
                             n = int(n_acc[req.slot, k])
                             if n < 0:     # request drafted nothing this round
                                 continue
+                            observed.append((int(d_used[req.slot, k]), n))
                             new_toks = [int(t)
                                         for t in a[req.slot, k, : n + 1]]
                             # trim the accepted chunk at the generation
@@ -821,6 +939,8 @@ class RequestManager:
                             round_events.append((k, n, len(new_toks)))
                             if self._finish_if_done(req, max_seq):
                                 break
+                        if ctrl is not None:
+                            ctrl.observe_block(req.guid, observed)
                         self._note_first_token(req)
                         if tel is not None and round_events:
                             tel.trace_rounds(req.guid, round_events,
@@ -831,12 +951,16 @@ class RequestManager:
             for slot in range(R):
                 req = active[slot]
                 if req is not None and req.finished:
+                    if ctrl is not None:
+                        ctrl.drop(req.guid)
                     done.append(self._collect(req))
                     active[slot] = None
         return done
 
     def _generate_spec_tree_fused(self, llm, ssms: List[Any],
-                                  spec_depth: Optional[int] = None
+                                  spec_depth: Optional[int] = None,
+                                  generation_config:
+                                  Optional[GenerationConfig] = None
                                   ) -> List[GenerationResult]:
         """Multi-SSM tree speculation with the fused MultiSpecEngine.
 
@@ -867,6 +991,8 @@ class RequestManager:
         max_seq = cfg.max_sequence_length
         B = len(ssms)
         depth = min(spec_depth or self.max_spec_depth, self.max_spec_depth)
+        ctrl, gc = self._spec_controller(generation_config, llm, ssms,
+                                         engine_depth=depth)
         engine = getattr(llm, "_multi_engine", None)
         if (engine is None or [s for s in engine.ssms] != list(ssms)
                 or engine.depth != depth):
@@ -900,7 +1026,9 @@ class RequestManager:
                     cfg.max_tokens_per_batch)
                 rows = [(slot, toks, sp) for slot, toks, sp in rows
                         if max_seq - len(active[slot].tokens)
-                        >= room_needed]
+                        >= room_needed
+                        and (ctrl is None
+                             or ctrl.wants_draft(active[slot].guid))]
                 if rows:
                     meta = self._meta_from_rows(R, chunk, rows)
                     self._timed_prefill(ifm, meta, tel, rows, active)
@@ -913,10 +1041,13 @@ class RequestManager:
                     if req is not None and not req.finished]
             if not live:
                 continue
-            draftable = [req for req in live
-                         if max_seq - len(req.tokens) >= room_needed]
+            roomy = [req for req in live
+                     if max_seq - len(req.tokens) >= room_needed]
             cramped = [req for req in live
                        if max_seq - len(req.tokens) < room_needed]
+            draftable, parked, rounds = self._partition_spec(
+                ctrl, tel, live, roomy,
+                min(cfg.spec_rounds_per_call, engine.max_rounds))
             if cramped:
                 # cache nearly full: finish token by token (chain-path
                 # parity; the fused tree needs B*depth+1 staging slots)
@@ -938,11 +1069,18 @@ class RequestManager:
                             req.ssm_cache_depth.get(i, 0), sp)
                     self._note_first_token(req)
                     self._finish_if_done(req, max_seq)
+            if parked:
+                self._fallback_decode(llm_ifm, parked, R, max_seq, cfg, tel)
+                for req in parked:
+                    ctrl.note_fallback_block(req.guid)
             if draftable:
                 tok = np.zeros((R,), np.int32)
                 pos = np.zeros((R,), np.int32)
                 act = np.zeros((R,), bool)
                 remaining = np.zeros((R,), np.int32)
+                depth_vec = None
+                if ctrl is not None:
+                    depth_vec = np.full((R,), depth, np.int32)
                 for req in draftable:
                     assert req.cache_depth == len(req.tokens) - 1
                     for i in range(B):
@@ -952,20 +1090,24 @@ class RequestManager:
                     pos[req.slot] = len(req.tokens) - 1
                     act[req.slot] = True
                     remaining[req.slot] = self._remaining_budget(req, max_seq)
-                rounds = min(cfg.spec_rounds_per_call, engine.max_rounds)
+                    if ctrl is not None:
+                        depth_vec[req.slot] = ctrl.depth_for(req.guid)
                 self._tel_tick(tel, draftable, R, max_seq)
                 engine.telemetry = self.telemetry   # see chain-path note
                 t0 = time.perf_counter()
-                toks, n_acc = engine.run_block(tok, pos, act, rounds,
-                                               remaining)
+                toks, n_acc, d_used = engine.run_block(
+                    tok, pos, act, rounds, remaining, depth=depth_vec,
+                    min_depth=gc.min_spec_depth)
                 block_dt = time.perf_counter() - t0
                 for req in draftable:
                     last_rpos = len(req.tokens) - 1
                     round_events = []
+                    observed = []
                     for k in range(rounds):
                         n = int(n_acc[req.slot, k])
                         if n < 0:
                             continue
+                        observed.append((int(d_used[req.slot, k]), n))
                         last_rpos = len(req.tokens) - 1
                         new_toks = ([int(t) for t in toks[req.slot, k, :n]]
                                     + [int(toks[req.slot, k, depth])])
@@ -979,6 +1121,8 @@ class RequestManager:
                         round_events.append((k, n, len(new_toks)))
                         if self._finish_if_done(req, max_seq):
                             break
+                    if ctrl is not None:
+                        ctrl.observe_block(req.guid, observed)
                     self._note_first_token(req)
                     if tel is not None and round_events:
                         tel.trace_rounds(req.guid, round_events, t0,
@@ -996,6 +1140,8 @@ class RequestManager:
             for slot in range(R):
                 req = active[slot]
                 if req is not None and req.finished:
+                    if ctrl is not None:
+                        ctrl.drop(req.guid)
                     done.append(self._collect(req))
                     active[slot] = None
         return done

@@ -37,8 +37,12 @@ ffsv_request_ttft_seconds        histogram  admission -> first token
 ffsv_request_queue_wait_seconds  histogram  admission -> batch-slot grant
 ffsv_request_prefill_seconds     histogram  slot grant -> first token
 ffsv_per_token_latency_seconds   histogram  latency / output tokens
-ffsv_draft_depth                 gauge      current speculation chain depth
+ffsv_draft_depth                 gauge      compiled speculation chain depth
 ffsv_tree_width                  gauge      verify-pass token-tree width
+ffsv_spec_effective_depth        histogram  controller depth per spec round
+ffsv_spec_fallback_total         counter    requests parked on incremental
+ffsv_spec_fallback_active        gauge      requests currently parked
+ffsv_spec_acceptance_ewma        gauge      mean controller acceptance EWMA
 ===============================  =========  =================================
 
 The request-level SLO histograms (latency/ttft/queue-wait/prefill/
@@ -145,9 +149,23 @@ class ServingTelemetry:
             "ffsv_per_token_latency_seconds",
             "request latency / output tokens", window_s=win)
         self.draft_depth = r.gauge(
-            "ffsv_draft_depth", "current speculation chain depth")
+            "ffsv_draft_depth", "compiled speculation chain depth")
         self.tree_width = r.gauge(
             "ffsv_tree_width", "verify-pass token-tree width")
+        # adaptive speculation controller (serve/spec_controller.py)
+        self.spec_effective_depth = r.histogram(
+            "ffsv_spec_effective_depth",
+            "controller-chosen draft depth per speculation round",
+            buckets=COUNT_BUCKETS)
+        self.spec_fallback_total = r.counter(
+            "ffsv_spec_fallback_total",
+            "times a request was parked on incremental decoding")
+        self.spec_fallback_active = r.gauge(
+            "ffsv_spec_fallback_active",
+            "requests currently parked on incremental decoding")
+        self.spec_acceptance_ewma = r.gauge(
+            "ffsv_spec_acceptance_ewma",
+            "mean per-token acceptance EWMA over live spec requests")
 
     # -- hooks (serve/request_manager.py, serve/engine.py) ---------------
     def note_admission(self, guid: int, prompt_tokens: int,
@@ -179,19 +197,37 @@ class ServingTelemetry:
             self.tracer.decode_block(g, steps, t0, seconds)
 
     def record_spec_block(self, seconds: float, n_acc: np.ndarray,
-                          depth: int, tree_width: int):
+                          depth: int, tree_width: int, depths=None):
         """After one fused speculation block (all engines): ``n_acc`` is
         the packed [R, rounds] accepted-length matrix, -1 marking idle
         rounds. Called from engine.run_block, so bench/direct engine
-        drivers are instrumented too, not just the RequestManager."""
+        drivers are instrumented too, not just the RequestManager.
+        ``depths`` (same shape, optional) is the per-round EFFECTIVE
+        draft depth the adaptive controller ran each row under."""
         self.spec_block_seconds.observe(seconds)
         self.draft_depth.set(depth)
         self.tree_width.set(tree_width)
         valid = np.asarray(n_acc).ravel()
-        valid = valid[valid >= 0]
+        mask = valid >= 0
+        valid = valid[mask]
         self.spec_rounds.inc(int(valid.size))
         self.acceptance_length.observe_many(valid.tolist())
         self.tokens_per_round.observe_many((valid + 1).tolist())
+        if depths is not None:
+            dv = np.asarray(depths).ravel()[mask]
+            self.spec_effective_depth.observe_many(dv[dv > 0].tolist())
+
+    def note_spec_controller(self, ewma_mean, n_fallback: int,
+                             new_fallbacks: int):
+        """Once per scheduling tick that consulted the adaptive
+        speculation controller: batch-mean acceptance EWMA, requests
+        currently parked on incremental decoding, and how many parked
+        since the last tick."""
+        if ewma_mean is not None:
+            self.spec_acceptance_ewma.set(ewma_mean)
+        self.spec_fallback_active.set(n_fallback)
+        if new_fallbacks > 0:
+            self.spec_fallback_total.inc(new_fallbacks)
 
     def trace_rounds(self, guid: int, committed_per_round, block_t0: float,
                      block_dur: float, rounds_in_block: int):

@@ -196,16 +196,41 @@ char *ffsv_config_get(void *cfg, const char *key);   /* caller frees */
 /* Build + compile a serving model. spec_json:
  * {"family":"llama|opt|falcon|mpt|starcoder",
  *  "model_config":{...family Config kwargs...},
- *  "mode":"inc|spec|tree", "weights_npz":"path" (optional)} */
+ *  "mode":"inc|spec|tree", "weights_npz":"path" (optional),
+ *  "generation_config":{...} (optional)}
+ *
+ * generation_config keys (all optional; defaults in parentheses) drive
+ * the adaptive speculation controller — the same per-request depth
+ * tuning + incremental fallback the Python serving stack runs, so an
+ * embedded C host's spec decoding never loses to plain decoding:
+ *   "adaptive": bool        (true)  controller on/off
+ *   "spec_depth": int       (0)     max draft depth; 0 = caller's depth
+ *   "min_spec_depth": int   (1)     shrink floor
+ *   "fallback_margin": f    (0.95)  park below this est. speedup
+ *   "recover_margin": f     (1.05)  un-park above this (hysteresis)
+ *   "probe_every": int      (4)     fallback blocks between probe rounds
+ *   "ewma_alpha": f         (0.4)   acceptance-EWMA smoothing
+ *   "draft_cost_ratio": f   (0)     0 = estimate from parameter bytes
+ * Unknown keys fail the create (ffsv_last_error) rather than running
+ * with silently-defaulted policy. Controller state is observable via
+ * ffsv_metrics_dump: ffsv_spec_effective_depth / _fallback_total /
+ * _fallback_active / _acceptance_ewma. */
 void *ffsv_llm_create(void *cfg, const char *spec_json);
 
 /* Speculative-decoding pair: verifier (tree-verify) + draft SSM
  * (beam-search) — the reference's spec_infer main
- * (inference/spec_infer/spec_infer.cc:201). Same JSON schema. */
+ * (inference/spec_infer/spec_infer.cc:201). Same JSON schema; the
+ * VERIFIER spec's generation_config carries the pair-level adaptive
+ * policy. draft_json is either one model spec or {"ssms":[spec, ...]}
+ * for multi-SSM drafting (all SSMs propose into one merged token tree
+ * per round). */
 void *ffsv_spec_create(void *cfg, const char *verifier_json,
                        const char *draft_json);
 /* spec_depth: draft-chain depth per round, must be >= 1 (returns -1
- * otherwise; there is no 0-means-default). */
+ * otherwise; there is no 0-means-default). The verifier spec's
+ * generation_config.spec_depth, when set, overrides this argument;
+ * with the adaptive controller on (default) the value is the COMPILED
+ * maximum and the effective per-request depth adapts below it. */
 int ffsv_generate_spec(void *llm, int spec_depth);
 
 /* Register a tokenized prompt; returns the request guid, or -1. */

@@ -168,7 +168,9 @@ char *ffsv_config_get(void *cfg, const char *key) {
 }
 
 /* Build + compile a serving model from the JSON spec documented in
- * capi_host.llm_create (family, model_config, mode, weights_npz). */
+ * capi_host.llm_create (family, model_config, mode, weights_npz,
+ * generation_config — the optional adaptive-speculation policy object;
+ * see flexflow_tpu_c.h for the key set). */
 void *ffsv_llm_create(void *cfg, const char *spec_json) {
   return call("llm_create",
               Py_BuildValue("(Os)", (PyObject *)cfg, spec_json));
@@ -190,10 +192,13 @@ long ffsv_register_request(void *llm, const int32_t *tokens, int n_tokens,
 }
 
 /* Build + compile a speculative-decoding pair: verifier (tree-verify
- * mode) + draft SSM (beam-search mode) — the reference's spec_infer
+ * mode) + draft SSM(s) (beam-search mode) — the reference's spec_infer
  * main (inference/spec_infer/spec_infer.cc:201). Both specs use the
- * llm_create JSON schema; register requests and call
- * ffsv_generate_spec on the returned handle. */
+ * llm_create JSON schema; draft_json may be {"ssms":[spec, ...]} for
+ * multi-SSM merged-tree drafting, and the verifier spec's
+ * generation_config carries the adaptive-speculation policy (depth
+ * bounds, fallback threshold — flexflow_tpu_c.h). Register requests
+ * and call ffsv_generate_spec on the returned handle. */
 void *ffsv_spec_create(void *cfg, const char *verifier_json,
                        const char *draft_json) {
   return call("spec_create", Py_BuildValue("(Oss)", (PyObject *)cfg,
@@ -201,7 +206,8 @@ void *ffsv_spec_create(void *cfg, const char *verifier_json,
 }
 
 /* Speculative decoding for every pending request. Returns finished
- * count, or -1. */
+ * count, or -1. spec_depth must be >= 1; generation_config.spec_depth
+ * (verifier spec JSON) overrides it when set. */
 int ffsv_generate_spec(void *llm, int spec_depth) {
   PyObject *r = call("generate_spec",
                      Py_BuildValue("(Oi)", (PyObject *)llm, spec_depth));

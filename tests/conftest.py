@@ -28,7 +28,8 @@ import pytest  # noqa: E402
 # loadgen — BEHIND the cutoff, so their dots never counted. Hoist them to
 # the front of the run: they share one tiny session-scoped spec pair and
 # finish in seconds, so the reordering costs the heavier files nothing.
-_EARLY_FILES = ("test_loadgen.py", "test_telemetry.py")
+_EARLY_FILES = ("test_loadgen.py", "test_telemetry.py",
+                "test_spec_controller.py")
 
 
 def pytest_collection_modifyitems(session, config, items):

@@ -227,6 +227,48 @@ def test_bench_trend_flags_synthetic_regression(tmp_path, capsys):
     regressions, _ = bt.check_trajectory(bt.load_rounds(str(tmp_path)))
     assert any("serving_load.peak_tokens_per_s" in r for r in regressions)
 
+    # acceptance-sweep regression (adaptive speculation controller, ROADMAP
+    # item 1): spec re-collapsing below incremental at one damping regime
+    # must fail the gate — the [eps=...] list selector reaches into the
+    # per-eps entries of the bf16_acceptance_sweep list
+    s5, s6 = dict(good), dict(good)
+    s5["parsed"] = dict(good["parsed"])
+    s5["parsed"]["bf16_acceptance_sweep"] = [
+        {"eps": 0.05, "speedup_vs_incr": 1.30},
+        {"eps": 0.2, "speedup_vs_incr": 0.99},
+        {"eps": 1.0, "speedup_vs_incr": 0.97}]
+    s6["n"] = 6
+    s6["parsed"] = dict(good["parsed"])
+    s6["parsed"]["bf16_acceptance_sweep"] = [
+        {"eps": 0.05, "speedup_vs_incr": 1.28},
+        {"eps": 0.2, "speedup_vs_incr": 0.50},      # controller regressed
+        {"eps": 1.0, "speedup_vs_incr": 0.96}]
+    (tmp_path / "BENCH_r05.json").write_text(json.dumps(s5))
+    (tmp_path / "BENCH_r06.json").write_text(json.dumps(s6))
+    regressions, _ = bt.check_trajectory(bt.load_rounds(str(tmp_path)))
+    assert any("bf16_acceptance_sweep[eps=0.2].speedup_vs_incr" in r
+               for r in regressions)
+    assert not any("eps=1.0" in r for r in regressions)   # small drop ok
+
+    # absolute never-lose floor: an adaptive round whose sweep dips below
+    # 0.95 fails even with NO prior sweep to regress from; pre-controller
+    # rounds (no adaptive_spec marker) are never floored retroactively
+    f6 = dict(good)
+    f6["n"] = 7
+    f6["parsed"] = dict(good["parsed"])
+    f6["parsed"]["adaptive_spec"] = True
+    f6["parsed"]["bf16_acceptance_sweep"] = [
+        {"eps": 1.0, "speedup_vs_incr": 0.90}]
+    (tmp_path / "BENCH_r07.json").write_text(json.dumps(f6))
+    regressions, _ = bt.check_trajectory(bt.load_rounds(str(tmp_path)))
+    assert any("below absolute floor" in r and "eps=1.0" in r
+               for r in regressions)
+    f6["parsed"]["bf16_acceptance_sweep"] = [
+        {"eps": 1.0, "speedup_vs_incr": 0.97}]
+    (tmp_path / "BENCH_r07.json").write_text(json.dumps(f6))
+    regressions, _ = bt.check_trajectory(bt.load_rounds(str(tmp_path)))
+    assert not any("below absolute floor" in r for r in regressions)
+
 
 def test_format_report_renders():
     steps = [{"offered_rps": 2.0, "achieved_rps": 1.9,

@@ -594,6 +594,69 @@ def test_ffsv_serving_abi_in_process():
     finally:
         disable_telemetry()
 
+    # --- adaptive speculation through the C ABI: generation_config +
+    # multi-SSM {"ssms": [...]} spec JSON (the embedded-host face of
+    # serve/spec_controller.py) ---
+    from flexflow_tpu.telemetry import (disable_telemetry as _dis,
+                                        enable_telemetry as _en)
+
+    gcfg_spec = (b'{"family": "llama", "model_config": {'
+                 b'"vocab_size": 128, "hidden_size": 64, '
+                 b'"intermediate_size": 128, "num_hidden_layers": 4, '
+                 b'"num_attention_heads": 4, "num_key_value_heads": 2, '
+                 b'"max_position_embeddings": 64}, '
+                 b'"generation_config": {"adaptive": true, '
+                 b'"spec_depth": 3, "min_spec_depth": 1, '
+                 b'"fallback_margin": 0.95, "probe_every": 4, '
+                 b'"draft_cost_ratio": 0.2}}')
+    drafts_spec = (b'{"ssms": [{"family": "llama", "model_config": {'
+                   b'"vocab_size": 128, "hidden_size": 64, '
+                   b'"intermediate_size": 128, "num_hidden_layers": 2, '
+                   b'"num_attention_heads": 4, "num_key_value_heads": 2, '
+                   b'"max_position_embeddings": 64}}, '
+                   b'{"family": "llama", "model_config": {'
+                   b'"vocab_size": 128, "hidden_size": 64, '
+                   b'"intermediate_size": 128, "num_hidden_layers": 1, '
+                   b'"num_attention_heads": 4, "num_key_value_heads": 2, '
+                   b'"max_position_embeddings": 64}}]}')
+    apair = lib.ffsv_spec_create(cfg, gcfg_spec, drafts_spec)
+    assert apair, lib.ffsv_last_error()
+    # in-process: the opaque handle IS the _SpecHost — pin the parsed
+    # policy and the multi-SSM build directly
+    host = ctypes.cast(ctypes.c_void_p(apair), ctypes.py_object).value
+    assert len(host.ssms) == 2
+    assert host.gen_cfg is not None and host.gen_cfg.adaptive_spec
+    assert host.gen_cfg.spec_depth == 3
+    assert host.gen_cfg.spec_fallback_margin == pytest.approx(0.95)
+    _en()
+    try:
+        ap = (c.c_int32 * 3)(5, 9, 23)
+        ag = lib.ffsv_register_request(apair, ap, 3, 8)
+        # depth arg 2: generation_config.spec_depth=3 must override it
+        assert ag >= 0 and lib.ffsv_generate_spec(apair, 2) == 1, \
+            lib.ffsv_last_error()
+        an = lib.ffsv_get_output(apair, ag, out, 16)
+        assert an == 8, lib.ffsv_last_error()
+        ptr = lib.ffsv_metrics_dump(b"json")
+        assert ptr, lib.ffsv_last_error()
+        snap = _mjson.loads(ctypes.string_at(ptr).decode())
+        libc_m.free(ptr)
+        # the depth controller ENGAGED on the C-host path: effective
+        # depths were recorded (and never above the JSON's spec_depth),
+        # and the fallback/EWMA gauges exist for host dashboards
+        eff = snap["ffsv_spec_effective_depth"]
+        assert eff["count"] >= 1
+        assert eff["percentiles"]["p99"] <= 3     # JSON spec_depth bound
+        assert "ffsv_spec_fallback_active" in snap
+        assert "ffsv_spec_acceptance_ewma" in snap
+    finally:
+        _dis()
+    lib.ffsv_release(apair)
+    # a typo'd generation_config key must fail the create loudly
+    bad = gcfg_spec.replace(b'"adaptive"', b'"adaptve"')
+    assert not lib.ffsv_llm_create(cfg, bad)
+    assert b"generation_config" in lib.ffsv_last_error()
+
     # text surface (reference flexflow_model_generate takes TEXT): a
     # toy byte-level vocab round-trips prompt -> tokens -> text
     import json as _json

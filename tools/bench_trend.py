@@ -53,12 +53,60 @@ HEADLINES: Dict[str, float] = {
     "serving_load.peak_goodput_tokens_per_s": 0.10,
     "serving_load.knee_rps": 0.34,       # knee is step-quantized: only a
                                          # lost step (/step-mult) is real
+    # acceptance-realism sweep: spec speedup vs incremental per damping
+    # regime (bf16 child line). With the adaptive speculation controller
+    # these must hold >= ~1.0 at EVERY eps (ROADMAP item 1: spec never
+    # loses to incremental) — a controller regression re-collapsing a
+    # regime toward the static engine's 0.48-0.80x shows up as a large
+    # relative drop here and fails the gate.
+    "bf16_acceptance_sweep[eps=0.05].speedup_vs_incr": 0.07,
+    "bf16_acceptance_sweep[eps=0.2].speedup_vs_incr": 0.07,
+    "bf16_acceptance_sweep[eps=1.0].speedup_vs_incr": 0.07,
+}
+
+# Absolute floors, enforced on the LATEST round only when its bench line
+# ran with the adaptive controller (parsed["adaptive_spec"] is true) —
+# relative-to-prior gating alone cannot express the never-lose contract
+# (a first-ever or slowly-eroding sub-break-even sweep value would pass).
+# Pre-controller rounds (r01-r05) lack the marker and are not floored.
+FLOORS: Dict[str, float] = {
+    "bf16_acceptance_sweep[eps=0.05].speedup_vs_incr": 0.95,
+    "bf16_acceptance_sweep[eps=0.2].speedup_vs_incr": 0.95,
+    "bf16_acceptance_sweep[eps=1.0].speedup_vs_incr": 0.95,
 }
 
 
 def _get_path(d: dict, path: str):
+    """Walk a dotted path; a segment like ``name[key=value]`` selects the
+    element of a list-of-dicts whose ``key`` equals ``value`` (numeric
+    compare when both parse) — how the acceptance-sweep entries are
+    addressed."""
     cur = d
-    for part in path.split("."):
+    # segment on dots OUTSIDE brackets ("[eps=0.2]" keeps its dot)
+    for part in re.findall(r"[^.\[\]]+(?:\[[^\]]*\])?", path):
+        m = re.fullmatch(r"([^\[]+)\[([^=\]]+)=([^\]]+)\]", part)
+        if m:
+            name, key, want = m.groups()
+            if not isinstance(cur, dict) or name not in cur \
+                    or not isinstance(cur[name], list):
+                return None
+            sel = None
+            for item in cur[name]:
+                if not isinstance(item, dict):
+                    continue
+                have = item.get(key)
+                try:
+                    if float(have) == float(want):
+                        sel = item
+                        break
+                except (TypeError, ValueError):
+                    if str(have) == want:
+                        sel = item
+                        break
+            if sel is None:
+                return None
+            cur = sel
+            continue
         if not isinstance(cur, dict) or part not in cur:
             return None
         cur = cur[part]
@@ -111,10 +159,27 @@ def check_trajectory(rounds: Sequence[dict],
     lines.append(
         f"gating r{latest['round']:02d} (config {latest['config']!r}) "
         f"against {len(prior)} prior same-config round(s)")
-    if not prior:
-        lines.append("no prior same-config rounds — gate passes vacuously")
-        return [], lines
     regressions = []
+    # absolute floors apply even to a FIRST-of-its-config round (a fresh
+    # sub-break-even sweep has no prior to regress from but still fails
+    # the never-lose contract)
+    if latest["parsed"].get("adaptive_spec") is True:
+        for metric, floor in sorted(FLOORS.items()):
+            cur = _get_path(latest["parsed"], metric)
+            if cur is None:
+                continue
+            tag = "FLOOR-FAIL" if cur < floor else "ok"
+            lines.append(f"  {tag:>10}  {metric:<40} {cur:>10.4g}  "
+                         f"(absolute floor {floor:.2f})")
+            if cur < floor:
+                regressions.append(
+                    f"{metric}: r{latest['round']:02d} {cur:.4g} below "
+                    f"absolute floor {floor:.2f} (spec losing to "
+                    f"incremental — adaptive controller regression)")
+    if not prior:
+        lines.append("no prior same-config rounds — relative gate "
+                     "passes vacuously")
+        return regressions, lines
     for metric, t in sorted(tol.items()):
         cur = _get_path(latest["parsed"], metric)
         if cur is None:
