@@ -89,6 +89,55 @@ int main(int argc, char **argv) {
   }
   printf("controller metrics present (ffsv_spec_effective_depth)\n");
   free(snap);
+
+  /* Overload-safety surface: cancellation + per-request timeouts.
+   * A request cancelled BEFORE its generate round resolves as
+   * status 2 (cancelled); one registered with a microscopic timeout
+   * resolves as status 1 (timed_out). Both keep partial output
+   * readable, and the finished request above reports status 0. */
+  if (ffsv_request_status(pair, g) != 0) {
+    fprintf(stderr, "finished request should report status 0\n");
+    return 1;
+  }
+  int32_t p2[] = {11, 3, 19};
+  long g_cancel = ffsv_register_request(pair, p2, 3, 6);
+  long g_timeout = ffsv_register_request_timeout(pair, p2, 3, 6, 1e-6);
+  if (g_cancel < 0 || g_timeout < 0) {
+    fprintf(stderr, "register failed: %s\n", ffsv_last_error());
+    return 1;
+  }
+  if (ffsv_request_status(pair, g_cancel) != 4) {
+    fprintf(stderr, "pending request should report status 4\n");
+    return 1;
+  }
+  if (ffsv_request_cancel(pair, g_cancel) != 1 ||
+      ffsv_request_cancel(pair, g_cancel) != 1) {
+    /* second call: flagging an already-flagged (still unfinished)
+     * request is still a successful cancel */
+    fprintf(stderr, "cancel failed: %s\n", ffsv_last_error());
+    return 1;
+  }
+  if (ffsv_generate_spec(pair, 3) != 2) {
+    fprintf(stderr, "generate after cancel/timeout failed: %s\n",
+            ffsv_last_error());
+    return 1;
+  }
+  if (ffsv_request_status(pair, g_cancel) != 2) {
+    fprintf(stderr, "cancelled request should report status 2, got %d\n",
+            ffsv_request_status(pair, g_cancel));
+    return 1;
+  }
+  if (ffsv_request_status(pair, g_timeout) != 1) {
+    fprintf(stderr, "timed-out request should report status 1, got %d\n",
+            ffsv_request_status(pair, g_timeout));
+    return 1;
+  }
+  if (ffsv_request_cancel(pair, g_cancel) != 0 ||
+      ffsv_request_status(pair, 424242) != -1) {
+    fprintf(stderr, "finished/unknown guid handling wrong\n");
+    return 1;
+  }
+  printf("cancel + timeout statuses OK\n");
   printf("C spec_infer OK\n");
   ffsv_release(pair);
   ffsv_release(cfg);

@@ -100,6 +100,12 @@ def _declare(lib: ctypes.CDLL):
     lib.ffs_done_tokens.argtypes = [c.c_void_p, c.c_int64, i32p, c.c_int]
     lib.ffs_prompt_len.restype = c.c_int
     lib.ffs_prompt_len.argtypes = [c.c_void_p, c.c_int64]
+    if hasattr(lib, "ffs_cancel"):
+        # absent in libraries built before cancellation support; callers
+        # probe NativeBatchScheduler.supports_cancel and fall back to the
+        # host-side python loop when missing
+        lib.ffs_cancel.restype = c.c_int
+        lib.ffs_cancel.argtypes = [c.c_void_p, c.c_int64]
 
     ip = c.POINTER(c.c_int)
     lib.ffgb_create.restype = c.c_void_p

@@ -27,20 +27,30 @@ from flexflow_tpu.serve.request_manager import (
 )
 from flexflow_tpu.serve.inference_manager import InferenceManager
 from flexflow_tpu.serve.api import LLM, SSM, init
+from flexflow_tpu.serve.admission import (AdmissionController, AdmissionPolicy,
+                                          RejectedError)
+from flexflow_tpu.serve.faultinject import EngineFault, FaultInjector, run_chaos
 from flexflow_tpu.serve.loadgen import (EngineHandle, LoadRunner, TenantSpec,
                                         WorkloadSpec, build_schedule,
-                                        summarize, sweep)
+                                        overload_run, summarize, sweep)
 from flexflow_tpu.telemetry import (ServingTelemetry, disable_telemetry,
                                     enable_telemetry, get_telemetry)
 
 __all__ = [
+    "AdmissionController",
+    "AdmissionPolicy",
+    "EngineFault",
     "EngineHandle",
+    "FaultInjector",
     "LLM",
     "LoadRunner",
+    "RejectedError",
     "SSM",
     "TenantSpec",
     "WorkloadSpec",
     "build_schedule",
+    "overload_run",
+    "run_chaos",
     "summarize",
     "sweep",
     "ServingTelemetry",

@@ -231,10 +231,20 @@ class LLM:
     # ------------------------------------------------------------------
     def generate(self, requests_or_prompts: Union[str, Sequence],
                  max_new_tokens: int = 128,
-                 max_length: int = 0
+                 max_length: int = 0,
+                 timeout_s: Optional[float] = None,
+                 tenant: str = "default",
+                 priority: int = 0
                  ) -> Union[GenerationResult, List[GenerationResult]]:
         """Generate (reference LLM.generate :407): continuous batching over
-        prompts; speculative tree decoding when SSMs are attached."""
+        prompts; speculative tree decoding when SSMs are attached.
+
+        ``timeout_s`` bounds each request's wall clock: past it the
+        request is cancelled between decode rounds and its result comes
+        back with ``timed_out=True`` and the partial output. ``tenant``/
+        ``priority`` feed admission control and deadline-aware slot
+        scheduling in server mode (serve/admission.py); in server mode
+        an over-limit submission raises ``RejectedError``."""
         if self.ffmodel is None:
             raise RuntimeError("call LLM.compile() before generate()")
         single = isinstance(requests_or_prompts, str) or (
@@ -251,14 +261,23 @@ class LLM:
             # concurrent generate() calls from other threads interleave
             # into the same running batch
             srv = self._server
-            guids, ev = srv.submit(prompts, max_new_tokens, max_length)
+            guids, ev = srv.submit(prompts, max_new_tokens, max_length,
+                                   timeout_s=timeout_s, tenant=tenant,
+                                   priority=priority)
             ev.wait()
             if srv._error is not None:
                 raise RuntimeError("serving loop died") from srv._error
+            missing = [g for g in guids if g not in self.rm.results]
+            if missing:
+                # stop_server()'s flush window expired before these
+                # finished — an explicit error, never a silent drop
+                raise RuntimeError(
+                    f"server stopped before request(s) {missing} resolved")
         else:
             guids = [self.rm.register_new_request(
                 p, max_new_tokens=max_new_tokens,
-                max_sequence_length=max_length) for p in prompts]
+                max_sequence_length=max_length, timeout_s=timeout_s,
+                tenant=tenant, priority=priority) for p in prompts]
             if self.ssms:
                 self.rm.generate_spec_infer(
                     self.ffmodel, [s.ffmodel for s in self.ssms])
@@ -268,25 +287,52 @@ class LLM:
         results = [self.rm.results[g] for g in guids]
         return results[0] if single else results
 
+    def cancel(self, request_id: int) -> bool:
+        """Cancel a registered request by guid (C ABI:
+        ``ffsv_request_cancel``). The serving loop reaps the flag at the
+        next between-rounds seam on every scheduler path; the request's
+        result resolves with ``cancelled=True`` and whatever tokens were
+        already generated. False when unknown or already finished."""
+        if self.rm is None:
+            return False
+        return self.rm.cancel(request_id)
+
     # ------------------------------------------------------------------
-    def start_server(self):
+    def start_server(self, admission=None):
         """Start the background RequestManager server (reference
         serve.py start_server): a daemon thread owns the generation step
         loop and a thread-safe submission queue, so concurrent
         ``generate`` calls interleave into one running continuous batch.
-        The device is only ever driven from the server thread."""
+        The device is only ever driven from the server thread.
+
+        ``admission`` (optional) bounds the front door: an
+        ``AdmissionPolicy`` (or prebuilt ``AdmissionController``) from
+        serve/admission.py — over-limit submissions then raise
+        ``RejectedError`` instead of queueing without bound."""
         if self.ffmodel is None:
             raise RuntimeError("call LLM.compile() before start_server()")
         if self._server is None:
-            self._server = _BackgroundServer(self)
+            ctrl = admission
+            if ctrl is not None:
+                from flexflow_tpu.serve.admission import (AdmissionController,
+                                                          AdmissionPolicy)
+
+                if isinstance(ctrl, AdmissionPolicy):
+                    ctrl = AdmissionController(ctrl)
+            self._server = _BackgroundServer(self, admission=ctrl)
             self._server.start()
         return self
 
-    def stop_server(self):
-        """Drain outstanding requests and stop the background server."""
+    def stop_server(self, flush_timeout_s: Optional[float] = 30.0):
+        """Drain outstanding requests and stop the background server:
+        flush-with-timeout (``flush_timeout_s`` per phase; None = wait
+        forever). If the drain window expires, outstanding requests are
+        cancelled — the loops reap cancellations between decode rounds,
+        so the second join is bounded by one block — and every waiter is
+        resolved rather than silently dropped."""
         srv = self._server
         if srv is not None:
-            srv.stop()
+            srv.stop(flush_timeout_s)
             self._server = None
         return self
 
@@ -325,10 +371,18 @@ class _BackgroundServer:
     while a round is in flight join its continuous batch at the next
     slot-fill (RequestManager's loops re-poll ``pending`` every
     iteration), so late submitters share device steps with the batch
-    already running."""
+    already running.
 
-    def __init__(self, llm: "LLM"):
+    Overload safety (serve/admission.py): when an ``admission``
+    controller is attached, submissions are admitted or rejected under
+    the same lock that registers them, so the queue-depth check and the
+    registration are atomic. Realized queue waits from every finished
+    round feed back into the controller's windowed p99, which is where
+    rejections get their retry-after hint."""
+
+    def __init__(self, llm: "LLM", admission=None):
         self.llm = llm
+        self.admission = admission
         self._work = threading.Condition()
         self._stopping = False
         # (remaining-guid-set, event) per submission
@@ -340,8 +394,9 @@ class _BackgroundServer:
     def start(self):
         self._thread.start()
 
-    def submit(self, prompts, max_new_tokens: int,
-               max_length: int) -> Tuple[List[int], threading.Event]:
+    def submit(self, prompts, max_new_tokens: int, max_length: int,
+               timeout_s: Optional[float] = None, tenant: str = "default",
+               priority: int = 0) -> Tuple[List[int], threading.Event]:
         ev = threading.Event()
         with self._work:
             if self._error is not None:
@@ -349,18 +404,49 @@ class _BackgroundServer:
             if self._stopping or not self._thread.is_alive():
                 raise RuntimeError(
                     "server is stopping/stopped; submit raced stop_server()")
+            if self.admission is not None:
+                depth = len(self.llm.rm.pending)
+                try:
+                    self.admission.admit(tenant, depth, n=len(prompts))
+                except Exception as e:
+                    tel = self.llm.rm._tel()
+                    if tel is not None:
+                        tel.note_rejected(tenant,
+                                          getattr(e, "reason", "rejected"),
+                                          depth)
+                    raise
             guids = [self.llm.rm.register_new_request(
                 p, max_new_tokens=max_new_tokens,
-                max_sequence_length=max_length) for p in prompts]
+                max_sequence_length=max_length, timeout_s=timeout_s,
+                tenant=tenant, priority=priority) for p in prompts]
             self._waiters.append((set(guids), ev))
             self._work.notify_all()
         return guids, ev
 
-    def stop(self):
+    def stop(self, flush_timeout_s: Optional[float] = 30.0):
         with self._work:
             self._stopping = True
             self._work.notify_all()
-        self._thread.join()
+        self._thread.join(flush_timeout_s)
+        if self._thread.is_alive():
+            # flush window expired mid-batch: cancel everything still
+            # outstanding — the loops reap cancel flags between decode
+            # rounds, so this second join is bounded by one block
+            rm = self.llm.rm
+            for guid in list(rm.inflight):
+                rm.cancel(guid)
+            self._thread.join(flush_timeout_s)
+        # every waiter resolves, even if its guids never produced results
+        # (LLM.generate turns a missing result into an explicit error)
+        with self._work:
+            for _, ev in self._waiters:
+                ev.set()
+            self._waiters.clear()
+        if not self._thread.is_alive():
+            # a clean shutdown must leave no native FIFO shadow entries —
+            # a leak here means a C++-scheduler request was lost
+            assert self.llm.rm.native_shadow_empty(), \
+                "native FIFO shadow not empty after stop()"
 
     def _run(self):
         rm = self.llm.rm
@@ -375,24 +461,36 @@ class _BackgroundServer:
                     return
             try:
                 if self.llm.ssms:
-                    rm.generate_spec_infer(
+                    done = rm.generate_spec_infer(
                         self.llm.ffmodel,
                         [s.ffmodel for s in self.llm.ssms])
                 else:
-                    rm.generate_incr_decoding(self.llm.ffmodel)
+                    done = rm.generate_incr_decoding(self.llm.ffmodel)
             except BaseException as e:       # surface to submitters
+                # fail every in-flight AND queued request with this error
+                # (each gets a status="error" result), then release all
+                # waiters — submitters raise instead of hanging forever.
+                # pending/inflight are now empty, so a restarted server
+                # starts clean.
+                rm.abort_outstanding(e)
                 with self._work:
                     self._error = e
                     for _, ev in self._waiters:
                         ev.set()
                     self._waiters.clear()
                 raise
+            if self.admission is not None:
+                with self._work:
+                    for res in done or ():
+                        if res.queue_wait_s > 0.0:
+                            self.admission.observe_queue_wait(
+                                res.queue_wait_s)
             with self._work:
-                done = set(rm.results)
+                done_guids = set(rm.results)
                 fire = []
                 keep = []
                 for guids, ev in self._waiters:
-                    guids -= done
+                    guids -= done_guids
                     (keep if guids else fire).append((guids, ev))
                 self._waiters = keep
             for _, ev in fire:

@@ -56,6 +56,11 @@ class GenerationConfig:
     # (token-identical output either way; greedy acceptance commits the
     # verifier's own argmax sequence).
     adaptive_spec: bool = True
+    # default per-request wall-clock bound (seconds); 0 = no timeout.
+    # Applied at registration by embedded C hosts (capi_host) — a
+    # request past its deadline is cancelled between decode rounds and
+    # resolves with timed_out status and its partial output.
+    timeout_s: float = 0.0
     spec_depth: int = 0             # 0 = caller's depth / engine max
     min_spec_depth: int = 1
     spec_fallback_margin: float = 0.95   # park below this est. speedup

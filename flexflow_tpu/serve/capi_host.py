@@ -164,6 +164,7 @@ class _ServingHost:
 _GEN_CFG_KEYS = {
     "adaptive": "adaptive_spec",
     "adaptive_spec": "adaptive_spec",
+    "timeout_s": "timeout_s",
     "spec_depth": "spec_depth",
     "min_spec_depth": "min_spec_depth",
     "fallback_margin": "spec_fallback_margin",
@@ -212,6 +213,8 @@ def _parse_generation_config(spec: dict):
         ("draft_cost_ratio",
          isinstance(gc.spec_draft_cost_ratio, (int, float))
          and gc.spec_draft_cost_ratio >= 0, ">= 0 (0 = estimate)"),
+        ("timeout_s", isinstance(gc.timeout_s, (int, float))
+         and gc.timeout_s >= 0, ">= 0 (0 = no timeout)"),
     )
     for key, ok, want in checks:
         if not ok:
@@ -270,10 +273,48 @@ def llm_create(cfg, spec_json: str) -> _ServingHost:
 # requests + generation (reference RequestManager + flexflow_model_generate)
 # ---------------------------------------------------------------------------
 
+def _default_timeout(host: _ServingHost) -> Optional[float]:
+    """The spec JSON's generation_config.timeout_s (0/absent = None)."""
+    gc = host.gen_cfg
+    t = getattr(gc, "timeout_s", 0.0) if gc is not None else 0.0
+    return float(t) if t and t > 0 else None
+
+
 def register_request(host: _ServingHost, tokens: Sequence[int],
                      max_new_tokens: int) -> int:
     return host.rm.register_new_request(
-        [int(t) for t in tokens], max_new_tokens=int(max_new_tokens))
+        [int(t) for t in tokens], max_new_tokens=int(max_new_tokens),
+        timeout_s=_default_timeout(host))
+
+
+def register_request_timeout(host: _ServingHost, tokens: Sequence[int],
+                             max_new_tokens: int, timeout_s: float) -> int:
+    """``ffsv_register_request_timeout``: per-request wall-clock bound
+    (seconds; <= 0 = none, overriding any spec-JSON default)."""
+    return host.rm.register_new_request(
+        [int(t) for t in tokens], max_new_tokens=int(max_new_tokens),
+        timeout_s=float(timeout_s) if timeout_s > 0 else None)
+
+
+_STATUS_CODES = {"ok": 0, "timed_out": 1, "cancelled": 2, "error": 3}
+
+
+def request_cancel(host: _ServingHost, request_id: int) -> int:
+    """``ffsv_request_cancel``: flag a request for cancellation; the
+    next generate/generate_spec round reaps it (partial output kept).
+    1 = cancelled, 0 = unknown or already finished."""
+    return 1 if host.rm.cancel(int(request_id)) else 0
+
+
+def request_status(host: _ServingHost, request_id: int) -> int:
+    """``ffsv_request_status``: -1 unknown, 0 ok, 1 timed_out,
+    2 cancelled, 3 error, 4 registered-but-unfinished."""
+    rid = int(request_id)
+    res = host.rm.results.get(rid)
+    if res is not None:
+        return _STATUS_CODES.get(res.status, 3)
+    req = host.rm.inflight.get(rid)
+    return 4 if req is not None else -1
 
 
 def generate(host: _ServingHost) -> int:

@@ -45,6 +45,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from flexflow_tpu.serve.admission import RejectedError
 from flexflow_tpu.telemetry.metrics import percentile
 
 __all__ = [
@@ -58,6 +59,7 @@ __all__ = [
     "poisson_arrivals",
     "uniform_arrivals",
     "summarize",
+    "overload_run",
     "find_knee",
     "sweep",
     "format_report",
@@ -73,11 +75,17 @@ class TenantSpec:
     """One traffic class. ``weight`` is the sampling weight across
     tenants; ``deadline_s`` (optional) is the per-request completion SLO
     — requests finishing later still count as throughput but not as
-    goodput."""
+    goodput. ``priority`` feeds the RequestManager's slot scheduler
+    (higher grants first, and deadline-at-risk requests may preempt
+    lower-priority ones); ``timeout_s`` is a hard per-request wall-clock
+    bound — past it the request is cancelled between decode rounds and
+    resolves with ``timed_out`` status."""
 
     name: str = "default"
     weight: float = 1.0
     deadline_s: Optional[float] = None
+    priority: int = 0
+    timeout_s: Optional[float] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -111,6 +119,8 @@ class LoadRequest:
     prompt: List[int]
     max_new_tokens: int
     deadline_s: Optional[float] = None
+    priority: int = 0
+    timeout_s: Optional[float] = None
 
 
 def poisson_arrivals(rate_rps: float, n: int,
@@ -158,7 +168,9 @@ def build_schedule(spec: WorkloadSpec, n_requests: int, rate_rps: float,
         out.append(LoadRequest(idx=i, arrival_s=float(arrivals[i]),
                                tenant=tenant.name, prompt=prompt,
                                max_new_tokens=n_out,
-                               deadline_s=tenant.deadline_s))
+                               deadline_s=tenant.deadline_s,
+                               priority=tenant.priority,
+                               timeout_s=tenant.timeout_s))
     return out
 
 
@@ -191,17 +203,24 @@ class EngineHandle:
             self.rm.max_spec_depth = spec_depth
         self._server = None
 
-    def start_server(self):
+    def start_server(self, admission=None):
         from flexflow_tpu.serve.api import _BackgroundServer
 
         if self._server is None:
-            self._server = _BackgroundServer(self)
+            ctrl = admission
+            if ctrl is not None:
+                from flexflow_tpu.serve.admission import (AdmissionController,
+                                                          AdmissionPolicy)
+
+                if isinstance(ctrl, AdmissionPolicy):
+                    ctrl = AdmissionController(ctrl)
+            self._server = _BackgroundServer(self, admission=ctrl)
             self._server.start()
         return self
 
-    def stop_server(self):
+    def stop_server(self, flush_timeout_s: Optional[float] = 30.0):
         if self._server is not None:
-            self._server.stop()
+            self._server.stop(flush_timeout_s)
             self._server = None
         return self
 
@@ -221,6 +240,11 @@ class RequestRecord:
     queue_wait_s: float
     prefill_s: float
     deadline_s: Optional[float] = None
+    # ok | rejected | timed_out | cancelled | error — what resolved the
+    # request. Every scheduled request yields exactly one record (the
+    # every-future-resolves invariant), so nothing disappears from the
+    # accounting denominators.
+    status: str = "ok"
 
     @property
     def finished_s(self) -> float:
@@ -228,7 +252,10 @@ class RequestRecord:
 
     @property
     def met_deadline(self) -> bool:
-        """No deadline -> vacuously met (all tokens are goodput)."""
+        """No deadline -> vacuously met (all tokens are goodput); a
+        rejected/timed-out/cancelled/errored request never counts."""
+        if self.status != "ok":
+            return False
         return self.deadline_s is None or self.latency_s <= self.deadline_s
 
     @property
@@ -264,6 +291,7 @@ class LoadRunner:
         sem = (threading.Semaphore(int(closed_concurrency))
                if closed_concurrency else None)
         pending = []                       # (req, guid, ev, submitted_s)
+        records_rejected: List[RequestRecord] = []
         t0 = time.perf_counter()
         for req in schedule:
             if sem is not None:
@@ -278,7 +306,26 @@ class LoadRunner:
             delay = req.arrival_s - (time.perf_counter() - t0)
             if delay > 0:
                 time.sleep(delay)
-            guids, ev = srv.submit([req.prompt], req.max_new_tokens, 0)
+            try:
+                guids, ev = srv.submit([req.prompt], req.max_new_tokens, 0,
+                                       timeout_s=req.timeout_s,
+                                       tenant=req.tenant,
+                                       priority=req.priority)
+            except RejectedError:
+                # admission shed this request: it resolves RIGHT HERE as
+                # a rejection record (0 tokens, no latency) — never
+                # silently dropped from the accounting
+                if sem is not None:
+                    sem.release()
+                records_rejected.append(RequestRecord(
+                    idx=req.idx, tenant=req.tenant,
+                    scheduled_s=req.arrival_s,
+                    submitted_s=time.perf_counter() - t0,
+                    prompt_tokens=len(req.prompt), output_tokens=0,
+                    latency_s=0.0, ttft_s=0.0, queue_wait_s=0.0,
+                    prefill_s=0.0, deadline_s=req.deadline_s,
+                    status="rejected"))
+                continue
             pending.append((req, guids[0], ev, time.perf_counter() - t0))
             if sem is not None:
                 ev_local, sem_local = ev, sem
@@ -310,7 +357,9 @@ class LoadRunner:
                 output_tokens=len(res.output_tokens),
                 latency_s=res.latency_s, ttft_s=res.ttft_s,
                 queue_wait_s=res.queue_wait_s, prefill_s=res.prefill_s,
-                deadline_s=req.deadline_s))
+                deadline_s=req.deadline_s, status=res.status))
+        records.extend(records_rejected)
+        records.sort(key=lambda r: r.idx)
         return records
 
 
@@ -325,36 +374,63 @@ def _pcts(values, lo=50, hi=99):
 
 def summarize(records: Sequence[RequestRecord],
               duration_s: Optional[float] = None,
-              offered_rps: Optional[float] = None) -> dict:
+              offered_rps: Optional[float] = None,
+              n_scheduled: Optional[int] = None) -> dict:
     """Aggregate records into the SLO report dict.
 
     ``duration_s`` defaults to first-submit -> last-finish; callers with
     a wall-clocked pass may override. Goodput counts ONLY tokens from
     requests that met their deadline (requests without a deadline always
     count) — the metric that distinguishes "fast on average" from "fast
-    for the requests that still mattered"."""
+    for the requests that still mattered".
+
+    Rejected/timed-out requests are accounted EXPLICITLY: they stay in
+    ``n_requests`` and the ``deadline_met_fraction`` denominator (and
+    surface as ``n_rejected``/``n_timed_out``/...), but the latency/TTFT
+    percentiles and achieved_rps are computed over requests the engine
+    actually served (everything except rejections). ``n_scheduled``,
+    when given, yields ``resolved_fraction`` = records / scheduled — the
+    every-future-resolves invariant as a number (1.0 = nothing silently
+    dropped)."""
     recs = list(records)
     if not recs:
         return {"n_requests": 0}
+    # rejected requests never entered the engine: no latency to rank
+    served = [r for r in recs if r.status != "rejected"]
     if duration_s is None:
         start = min(r.submitted_s for r in recs)
         end = max(r.finished_s for r in recs)
         duration_s = max(end - start, 1e-9)
-    out_tokens = sum(r.output_tokens for r in recs)
+    out_tokens = sum(r.output_tokens for r in served)
     good_tokens = sum(r.output_tokens for r in recs if r.met_deadline)
-    lat_p50, lat_p99 = _pcts([r.latency_s for r in recs])
-    ttfts = [r.ttft_s for r in recs if r.ttft_s > 0]
-    ttft_p50, ttft_p99 = _pcts(ttfts) if ttfts else (0.0, 0.0)
-    tpot_p50, tpot_p99 = _pcts([r.tpot_s for r in recs])
-    qw_p50, qw_p99 = _pcts([r.queue_wait_s for r in recs])
-    mean_lat = sum(r.latency_s for r in recs) / len(recs)
-    mean_qw = sum(r.queue_wait_s for r in recs) / len(recs)
+    if served:
+        lat_p50, lat_p99 = _pcts([r.latency_s for r in served])
+        ttfts = [r.ttft_s for r in served if r.ttft_s > 0]
+        ttft_p50, ttft_p99 = _pcts(ttfts) if ttfts else (0.0, 0.0)
+        tpot_p50, tpot_p99 = _pcts([r.tpot_s for r in served])
+        qw_p50, qw_p99 = _pcts([r.queue_wait_s for r in served])
+        mean_lat = sum(r.latency_s for r in served) / len(served)
+        mean_qw = sum(r.queue_wait_s for r in served) / len(served)
+    else:
+        lat_p50 = lat_p99 = ttft_p50 = ttft_p99 = 0.0
+        tpot_p50 = tpot_p99 = qw_p50 = qw_p99 = 0.0
+        mean_lat = mean_qw = 0.0
+    n_by = {}
+    for r in recs:
+        n_by[r.status] = n_by.get(r.status, 0) + 1
     report = {
         "n_requests": len(recs),
+        "n_ok": n_by.get("ok", 0),
+        "n_rejected": n_by.get("rejected", 0),
+        "n_timed_out": n_by.get("timed_out", 0),
+        "n_cancelled": n_by.get("cancelled", 0),
+        "n_errors": n_by.get("error", 0),
+        "resolved_fraction": (round(len(recs) / n_scheduled, 4)
+                              if n_scheduled else 1.0),
         "duration_s": round(duration_s, 4),
         "offered_rps": (round(offered_rps, 4)
                         if offered_rps is not None else None),
-        "achieved_rps": round(len(recs) / duration_s, 4),
+        "achieved_rps": round(len(served) / duration_s, 4),
         "throughput_tokens_per_s": round(out_tokens / duration_s, 2),
         "goodput_tokens_per_s": round(good_tokens / duration_s, 2),
         "deadline_met_fraction": round(
@@ -378,11 +454,15 @@ def summarize(records: Sequence[RequestRecord],
         per = {}
         for t in tenants:
             tr = [r for r in recs if r.tenant == t]
-            tl50, tl99 = _pcts([r.latency_s for r in tr])
+            ts = [r for r in tr if r.status != "rejected"]
+            tl50, tl99 = (_pcts([r.latency_s for r in ts])
+                          if ts else (0.0, 0.0))
             per[t] = {
                 "n_requests": len(tr),
+                "n_rejected": sum(r.status == "rejected" for r in tr),
+                "n_timed_out": sum(r.status == "timed_out" for r in tr),
                 "throughput_tokens_per_s": round(
-                    sum(r.output_tokens for r in tr) / duration_s, 2),
+                    sum(r.output_tokens for r in ts) / duration_s, 2),
                 "goodput_tokens_per_s": round(
                     sum(r.output_tokens for r in tr if r.met_deadline)
                     / duration_s, 2),
@@ -448,6 +528,63 @@ def sweep(handle, spec: WorkloadSpec, rates: Sequence[float],
             s.get("throughput_tokens_per_s", 0.0) for s in steps),
         "peak_goodput_tokens_per_s": max(
             s.get("goodput_tokens_per_s", 0.0) for s in steps),
+    }
+
+
+def overload_run(handle, spec: WorkloadSpec, knee_rps: float,
+                 multiple: float = 2.0, n_requests: int = 32, seed: int = 0,
+                 process: str = "poisson", timeout_s: float = 300.0,
+                 admission=None) -> dict:
+    """Drive the engine PAST its measured knee and report how it sheds.
+
+    Offered load is ``multiple`` x ``knee_rps`` (the ISSUE/bench gate
+    runs at >=2x). When ``admission`` (an ``AdmissionPolicy`` or
+    ``AdmissionController``) is given, the handle's server is restarted
+    with it so over-limit submissions reject at the front door instead
+    of queueing without bound.
+
+    Headlines: ``priority_goodput`` — deadline-met fraction over the
+    highest-priority tenants' requests (the gate requires >= 0.95 at 2x
+    overload); ``resolved_fraction`` — every scheduled request came back
+    as exactly one record; ``besteffort_shed_fraction`` — how much
+    lower-priority traffic was rejected/timed out to protect them;
+    ``peak_queue_depth`` from the admission controller (bounded by the
+    policy when one is installed)."""
+    from flexflow_tpu.serve.admission import (AdmissionController,
+                                              AdmissionPolicy)
+
+    if admission is not None:
+        if isinstance(admission, AdmissionPolicy):
+            admission = AdmissionController(admission)
+        handle.stop_server()
+        handle.start_server(admission=admission)
+    elif getattr(handle, "_server", None) is None:
+        handle.start_server()
+    rate = float(knee_rps) * float(multiple)
+    schedule = build_schedule(spec, n_requests, rate, seed, process)
+    records = LoadRunner(handle).run(schedule, timeout_s=timeout_s)
+    report = summarize(records, offered_rps=rate,
+                       n_scheduled=len(schedule))
+    top = max(t.priority for t in spec.tenants)
+    prio_names = {t.name for t in spec.tenants if t.priority == top}
+    prio = [r for r in records if r.tenant in prio_names]
+    rest = [r for r in records if r.tenant not in prio_names]
+    shed = [r for r in rest if r.status != "ok"]
+    ctrl = admission if admission is not None else \
+        getattr(getattr(handle, "_server", None), "admission", None)
+    return {
+        "knee_rps": float(knee_rps),
+        "offered_multiple": float(multiple),
+        "offered_rps": rate,
+        "priority_tenants": sorted(prio_names),
+        "priority_goodput": (round(
+            sum(r.met_deadline for r in prio) / len(prio), 4)
+            if prio else 1.0),
+        "resolved_fraction": report["resolved_fraction"],
+        "besteffort_shed_fraction": (round(len(shed) / len(rest), 4)
+                                     if rest else 0.0),
+        "admission": ctrl.stats() if ctrl is not None else None,
+        "report": report,
     }
 
 

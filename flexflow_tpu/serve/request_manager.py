@@ -72,6 +72,18 @@ class Request:
     arrival_s: float = 0.0
     prefill_start_s: float = 0.0
     first_token_s: float = 0.0
+    # overload front door (ISSUE 16): tenant/priority drive admission
+    # buckets and slot scheduling; deadline_s is an ABSOLUTE
+    # time.perf_counter() instant (0.0 = none) — expiry and host
+    # cancellation are reaped between decode rounds (_reap_expired).
+    # ``status`` is the terminal disposition recorded on the result.
+    tenant: str = "default"
+    priority: int = 0
+    deadline_s: float = 0.0
+    status: str = "ok"                    # ok|timed_out|cancelled|error
+    error: str = ""
+    cancel_requested: bool = False
+    preemptions: int = 0
 
     def __post_init__(self):
         if not self.tokens:
@@ -105,6 +117,18 @@ class GenerationResult:
     # FIFO slot shadow); 0.0 only when attribution was impossible.
     queue_wait_s: float = 0.0
     prefill_s: float = 0.0
+    # terminal disposition (overload front door): "ok", "timed_out"
+    # (deadline expired between rounds — output_tokens holds the partial
+    # prefix generated so far), "cancelled" (host-side cancel), or
+    # "error" (the serving loop died; ``error`` carries the message).
+    # Every registered request ALWAYS gets a result with one of these —
+    # the every-future-resolves invariant serve/faultinject.py checks.
+    status: str = "ok"
+    timed_out: bool = False
+    cancelled: bool = False
+    error: str = ""
+    tenant: str = "default"
+    preemptions: int = 0
 
 
 class RequestManager:
@@ -119,6 +143,14 @@ class RequestManager:
         self.eos_token_id = eos_token_id
         self.pending: deque = deque()
         self.results: Dict[int, GenerationResult] = {}
+        # every registered-but-unfinished request, pending OR slotted —
+        # the cancel/abort surface (entries removed at _collect)
+        self.inflight: Dict[int, Request] = {}
+        # deadline-aware preemption (ISSUE 16c): a pending request whose
+        # deadline has burned down past preempt_risk of its total budget
+        # may evict a strictly-lower-priority running request
+        self.preempt_enabled = True
+        self.preempt_risk = 0.5
         self.max_spec_depth = MAX_BEAM_DEPTH
         self._commit = jax.jit(commit_tree_kv, donate_argnums=(0,))
         self.output_filepath: Optional[str] = None
@@ -145,7 +177,15 @@ class RequestManager:
 
     def register_new_request(self, prompt: Union[str, Sequence[int]],
                              max_new_tokens: int = 128,
-                             max_sequence_length: int = 0) -> int:
+                             max_sequence_length: int = 0,
+                             timeout_s: Optional[float] = None,
+                             deadline_s: Optional[float] = None,
+                             tenant: str = "default",
+                             priority: int = 0) -> int:
+        """Register one request. ``timeout_s`` is relative to arrival;
+        ``deadline_s`` is an absolute time.perf_counter() instant (wins
+        when both are given). An expired request is cancelled between
+        decode rounds with its partial output (``timed_out=True``)."""
         if isinstance(prompt, str):
             assert self.tokenizer is not None, "string prompts need a tokenizer"
             toks = list(self.tokenizer.encode(prompt))
@@ -153,14 +193,59 @@ class RequestManager:
             toks = list(int(t) for t in prompt)
         assert toks, "empty prompt"
         guid = next(self._guid_counter)
-        self.pending.append(Request(guid=guid, prompt_tokens=toks,
-                                    max_new_tokens=max_new_tokens,
-                                    max_sequence_length=max_sequence_length,
-                                    arrival_s=time.perf_counter()))
+        arrival = time.perf_counter()
+        if deadline_s is None and timeout_s is not None:
+            deadline_s = arrival + timeout_s
+        req = Request(guid=guid, prompt_tokens=toks,
+                      max_new_tokens=max_new_tokens,
+                      max_sequence_length=max_sequence_length,
+                      arrival_s=arrival, tenant=tenant, priority=priority,
+                      deadline_s=deadline_s or 0.0)
+        self.pending.append(req)
+        self.inflight[guid] = req
         tel = self._tel()
         if tel is not None:
             tel.note_admission(guid, len(toks), max_new_tokens)
         return guid
+
+    def cancel(self, guid: int) -> bool:
+        """Request cancellation (LLM.cancel / ffsv_request_cancel). Safe
+        from any thread: only sets a flag; the serving loop reaps it at
+        the next between-rounds seam on every scheduler path. Returns
+        False when the guid is unknown or already finished."""
+        req = self.inflight.get(guid)
+        if req is None or req.finished:
+            return False
+        req.cancel_requested = True
+        return True
+
+    def abort_outstanding(self, error: BaseException
+                          ) -> List[GenerationResult]:
+        """Fail every registered-but-unfinished request with ``error``
+        (status "error", partial tokens kept). Called when the serving
+        loop dies so no submitter waits on a result that will never
+        arrive; leaves the manager clean for a server restart."""
+        self.pending.clear()
+        out = []
+        for req in list(self.inflight.values()):
+            if req.finished:
+                continue
+            req.status = "error"
+            req.error = f"{type(error).__name__}: {error}"
+            req.finished = True
+            req.slot = -1
+            out.append(self._collect(req))
+        # the native loop's FIFO shadow died with the loop; clear it so
+        # the invariant check (and stop_server) see a consistent table
+        self._native_unslotted = deque()
+        self._native_slotted = {}
+        return out
+
+    def native_shadow_empty(self) -> bool:
+        """True when the native scheduler's FIFO shadow holds no
+        requests (always true outside a native-path generation loop)."""
+        return (not getattr(self, "_native_unslotted", None)
+                and not getattr(self, "_native_slotted", None))
 
     # -- scheduling helpers ------------------------------------------------
     def _finish_if_done(self, req: Request, max_seq: int) -> bool:
@@ -187,12 +272,16 @@ class RequestManager:
             queue_wait_s=(req.prefill_start_s - req.arrival_s)
             if req.prefill_start_s and req.arrival_s else 0.0,
             prefill_s=(req.first_token_s - req.prefill_start_s)
-            if req.first_token_s and req.prefill_start_s else 0.0)
+            if req.first_token_s and req.prefill_start_s else 0.0,
+            status=req.status, timed_out=req.status == "timed_out",
+            cancelled=req.status == "cancelled", error=req.error,
+            tenant=req.tenant, preemptions=req.preemptions)
+        self.inflight.pop(req.guid, None)
         tel = self._tel()
         if tel is not None:
             tel.note_finish(req.guid, len(out), res.latency_s, res.ttft_s,
                             queue_wait_s=res.queue_wait_s,
-                            prefill_s=res.prefill_s)
+                            prefill_s=res.prefill_s, status=req.status)
         if self.tokenizer is not None:
             try:
                 res.input_text = self.tokenizer.decode(res.input_tokens)
@@ -207,21 +296,123 @@ class RequestManager:
                         f"output: {res.output_text or res.output_tokens}\n")
         return res
 
+    def _next_pending(self) -> Optional[Request]:
+        """Dequeue the next request to grant a slot: highest priority
+        first, FIFO within a priority class (plain FIFO — the historical
+        behavior — when every pending priority is equal)."""
+        if not self.pending:
+            return None
+        best_i, best = 0, self.pending[0]
+        for i, r in enumerate(self.pending):
+            if r.priority > best.priority:
+                best_i, best = i, r
+        del self.pending[best_i]
+        return best
+
+    def _grant(self, req: Request, slot: int, active, max_seq: int,
+               done: List[GenerationResult]) -> bool:
+        """Place ``req`` in ``slot`` (rejecting over-long prompts straight
+        to done, the reference behavior). True when the slot was taken."""
+        limit = min(req.max_sequence_length or max_seq, max_seq)
+        if len(req.prompt_tokens) >= limit:
+            req.finished = True
+            done.append(self._collect(req))
+            return False
+        req.slot = slot
+        req.prefill_start_s = time.perf_counter()
+        active[slot] = req
+        return True
+
     def _fill_slots(self, active: List[Optional[Request]], max_seq: int,
-                    done: List[GenerationResult]):
+                    done: List[GenerationResult], parked=()):
         for slot in range(len(active)):
             while active[slot] is None and self.pending:
+                if self._grant(self._next_pending(), slot, active, max_seq,
+                               done):
+                    break
+        if self.pending and self.preempt_enabled:
+            # all slots taken and requests still waiting: deadline-aware
+            # preemption may evict a lower-priority victim (ISSUE 16c)
+            self._maybe_preempt(active, max_seq, done, parked)
+
+    def _maybe_preempt(self, active, max_seq: int,
+                       done: List[GenerationResult], parked=()):
+        """At the slot-grant seam: if a pending high-priority request's
+        deadline is at risk (more than ``preempt_risk`` of its budget
+        already burned waiting), evict a strictly-lower-priority running
+        request — preferring ones the speculation controller parked on
+        fallback decode, then the fewest generated tokens (cheapest
+        re-prefill). The victim is RE-QUEUED, not killed: its prompt +
+        generated prefix re-prefill through the chunked path on the next
+        grant, so its final tokens are identical (greedy decode depends
+        only on the token prefix)."""
+        now = time.perf_counter()
+        while self.pending:
+            cand = None
+            for r in self.pending:
+                if r.deadline_s <= 0 or r.cancel_requested:
+                    continue
+                total = max(r.deadline_s - r.arrival_s, 1e-9)
+                if (r.deadline_s - now) > self.preempt_risk * total:
+                    continue
+                if cand is None or r.priority > cand.priority:
+                    cand = r
+            if cand is None:
+                return
+            victims = [r for r in active
+                       if r is not None and not r.finished
+                       and r.priority < cand.priority]
+            if not victims:
+                return
+            victim = min(victims, key=lambda r: (r.guid not in parked,
+                                                 r.priority,
+                                                 r.num_generated))
+            slot = victim.slot
+            victim.slot = -1
+            victim.cache_depth = 0
+            victim.ssm_cache_depth.clear()
+            victim.preemptions += 1
+            victim.prefill_start_s = 0.0
+            active[slot] = None
+            self.pending.remove(cand)
+            self.pending.append(victim)
+            tel = self._tel()
+            if tel is not None:
+                tel.note_preempted(victim.guid)
+            self._grant(cand, slot, active, max_seq, done)
+
+    def _reap_expired(self, active, max_seq: int,
+                      done: List[GenerationResult], ctrl=None):
+        """The between-rounds timeout/cancel seam (ISSUE 16b): resolve
+        every pending or slotted request whose deadline expired or whose
+        host asked for cancellation — slot freed, partial result
+        collected with the matching status. Runs at the top of every
+        scheduler-loop iteration on all paths."""
+        now = time.perf_counter()
+
+        def expired(r):
+            return r.cancel_requested or (r.deadline_s
+                                          and now >= r.deadline_s)
+
+        if any(expired(r) for r in self.pending):
+            for _ in range(len(self.pending)):
                 req = self.pending.popleft()
-                limit = min(req.max_sequence_length or max_seq, max_seq)
-                if len(req.prompt_tokens) >= limit:
-                    # no room to generate even one token (reference
-                    # RequestManager rejects over-long prompts up front)
+                if expired(req):
+                    req.status = ("cancelled" if req.cancel_requested
+                                  else "timed_out")
                     req.finished = True
                     done.append(self._collect(req))
-                    continue
-                req.slot = slot
-                req.prefill_start_s = time.perf_counter()
-                active[slot] = req
+                else:
+                    self.pending.append(req)
+        for slot, req in enumerate(active):
+            if req is not None and not req.finished and expired(req):
+                req.status = ("cancelled" if req.cancel_requested
+                              else "timed_out")
+                req.finished = True
+                if ctrl is not None:
+                    ctrl.drop(req.guid)
+                done.append(self._collect(req))
+                active[slot] = None
 
     def _remaining_budget(self, req, max_seq: int) -> int:
         limit = min(req.max_sequence_length or max_seq, max_seq)
@@ -327,7 +518,18 @@ class RequestManager:
             except RuntimeError:
                 pass  # no toolchain: pure-Python path below
             if sched is not None:
-                return self._generate_incr_native(model, ifm, cfg, sched)
+                # priorities need the host's preemption machinery; and a
+                # stale libflexflow_tpu_native without ffs_cancel cannot
+                # reap deadlines/cancellations — both route to the
+                # Python loop rather than silently dropping the feature
+                needs_host = any(r.priority for r in self.pending)
+                if not sched.supports_cancel:
+                    needs_host = needs_host or any(
+                        r.deadline_s or r.cancel_requested
+                        for r in self.pending)
+                if not needs_host:
+                    return self._generate_incr_native(model, ifm, cfg,
+                                                      sched)
         R = cfg.max_requests_per_batch
         max_seq = cfg.max_sequence_length
         chunk = max(1, cfg.max_tokens_per_batch // max(1, min(R, 4)))
@@ -336,6 +538,7 @@ class RequestManager:
 
         while self.pending or any(a is not None for a in active):
             tel = self._tel()
+            self._reap_expired(active, max_seq, done)
             self._fill_slots(active, max_seq, done)
             rows = self._prefill_rows(active, chunk,
                                       lambda r: r.cache_depth,
@@ -415,6 +618,34 @@ class RequestManager:
                               req.max_new_tokens, req.max_sequence_length)
         done: List[GenerationResult] = []
         slotted: Dict[int, Request] = {}       # guid -> live slotted request
+        # expose the shadow for the stop_server()/fault-harness invariant
+        # (both must end empty when the loop exits)
+        self._native_unslotted = unslotted
+        self._native_slotted = slotted
+
+        def reap_native():
+            """Between-rounds timeout/cancel seam, native flavor: the C++
+            scheduler owns the slot table, so expiry/cancellation goes
+            through ffs_cancel (request moved to its done queue with the
+            partial tokens); drain() below collects it with the status
+            set here. An unslotted cancellee also leaves the FIFO shadow
+            (ffs_cancel removed it from the C++ pending queue, so the
+            pop order the shadow mirrors skips it too)."""
+            now = time.perf_counter()
+            for req in reqs.values():
+                if req.finished or req.status != "ok":
+                    continue
+                if req.cancel_requested or (req.deadline_s
+                                            and now >= req.deadline_s):
+                    status = ("cancelled" if req.cancel_requested
+                              else "timed_out")
+                    if sched.cancel(req.guid):
+                        req.status = status
+                        if req.guid not in slotted:
+                            try:
+                                unslotted.remove(req)
+                            except ValueError:
+                                pass
 
         def drain():
             while True:
@@ -441,8 +672,9 @@ class RequestManager:
 
         while sched.has_work():
             tel = self._tel()
+            reap_native()
             note_slots(sched.fill_slots())
-            drain()  # over-long prompts rejected straight to done
+            drain()  # over-long prompts + reaped requests -> done
             rows, tokens, positions, start, num, act = \
                 sched.assemble_prefill(chunk, cfg.max_tokens_per_batch, chunk)
             if rows:
@@ -667,6 +899,7 @@ class RequestManager:
 
         while self.pending or any(a is not None for a in active):
             tel = self._tel()
+            self._reap_expired(active, max_seq, done)
             self._fill_slots(active, max_seq, done)
             # ---- prompt prefill: verifier + every SSM ----
             prefilled = False
@@ -812,7 +1045,11 @@ class RequestManager:
 
         while self.pending or any(a is not None for a in active):
             tel = self._tel()
-            self._fill_slots(active, max_seq, done)
+            self._reap_expired(active, max_seq, done, ctrl)
+            parked_guids = ({req.guid for req in active if req is not None
+                             and ctrl.in_fallback(req.guid)}
+                            if ctrl is not None else ())
+            self._fill_slots(active, max_seq, done, parked_guids)
             # prompt prefill for both models (same path as incremental)
             prefilled = False
             for ifm, depth_of in ((llm_ifm, lambda r: r.cache_depth),
@@ -1010,7 +1247,11 @@ class RequestManager:
 
         while self.pending or any(a is not None for a in active):
             tel = self._tel()
-            self._fill_slots(active, max_seq, done)
+            self._reap_expired(active, max_seq, done, ctrl)
+            parked_guids = ({req.guid for req in active if req is not None
+                             and ctrl.in_fallback(req.guid)}
+                            if ctrl is not None else ())
+            self._fill_slots(active, max_seq, done, parked_guids)
             prefilled = False
             rows = self._prefill_rows(active, chunk, lambda r: r.cache_depth,
                                       cfg.max_tokens_per_batch)

@@ -20,6 +20,11 @@ name                             kind       meaning
 ===============================  =========  =================================
 ffsv_requests_total              counter    requests admitted
 ffsv_requests_finished_total     counter    requests completed
+ffsv_requests_rejected_total     counter    submissions refused at admission
+ffsv_requests_timed_out_total    counter    requests expired between rounds
+ffsv_requests_cancelled_total    counter    requests cancelled by the host
+ffsv_requests_preempted_total    counter    slot evictions for a deadline
+ffsv_queue_depth                 gauge      submission queue depth (front door)
 ffsv_tokens_generated_total      counter    output tokens committed
 ffsv_prefill_tokens_total        counter    prompt tokens prefilled
 ffsv_spec_rounds_total           counter    speculation rounds executed
@@ -100,6 +105,25 @@ class ServingTelemetry:
             "ffsv_requests_total", "requests admitted")
         self.requests_finished = r.counter(
             "ffsv_requests_finished_total", "requests completed")
+        # overload front door (serve/admission.py + request_manager):
+        # every non-success terminal disposition gets its own counter so
+        # a dashboard can see WHERE load is being shed
+        self.requests_rejected = r.counter(
+            "ffsv_requests_rejected_total",
+            "submissions refused by admission control")
+        self.requests_timed_out = r.counter(
+            "ffsv_requests_timed_out_total",
+            "requests whose deadline expired between decode rounds")
+        self.requests_cancelled = r.counter(
+            "ffsv_requests_cancelled_total",
+            "requests cancelled host-side (LLM.cancel / ffsv_request_cancel)")
+        self.requests_preempted = r.counter(
+            "ffsv_requests_preempted_total",
+            "slot evictions re-queueing a best-effort request for a "
+            "deadline-at-risk one")
+        self.submit_queue_depth = r.gauge(
+            "ffsv_queue_depth",
+            "submission queue depth (registered, not yet slotted)")
         self.tokens_generated = r.counter(
             "ffsv_tokens_generated_total", "output tokens committed")
         self.prefill_tokens = r.counter(
@@ -177,9 +201,21 @@ class ServingTelemetry:
                    kv_fraction: Optional[float]):
         """Once per host scheduling tick that dispatched device work."""
         self.queue_depth.set(pending)
+        self.submit_queue_depth.set(pending)
         self.batch_occupancy.observe(live / max(1, slots))
         if kv_fraction is not None:
             self.kv_utilization.observe(kv_fraction)
+
+    def note_rejected(self, tenant: str, reason: str, queue_depth: int):
+        """One admission rejection at the front door (serve/api.py's
+        submit path, before any request is registered)."""
+        self.requests_rejected.inc()
+        self.submit_queue_depth.set(queue_depth)
+
+    def note_preempted(self, guid: int):
+        """One slot eviction: a running best-effort request re-queued so
+        a deadline-at-risk higher-priority one takes its slot."""
+        self.requests_preempted.inc()
 
     def record_prefill(self, seconds: float, n_tokens: int, rows=()):
         self.prefill_seconds.observe(seconds)
@@ -239,8 +275,12 @@ class ServingTelemetry:
 
     def note_finish(self, guid: int, output_tokens: int, latency_s: float,
                     ttft_s: float, queue_wait_s: float = 0.0,
-                    prefill_s: float = 0.0):
+                    prefill_s: float = 0.0, status: str = "ok"):
         self.requests_finished.inc()
+        if status == "timed_out":
+            self.requests_timed_out.inc()
+        elif status == "cancelled":
+            self.requests_cancelled.inc()
         self.tokens_generated.inc(output_tokens)
         if latency_s > 0:
             self.request_latency.observe(latency_s)

@@ -86,6 +86,13 @@ int ffs_decode_block(void *handle, int max_block);
  * Returns the number of requests finished by this block. */
 int ffs_append_block(void *handle, const int32_t *toks, int B);
 
+/* Cancel a request by guid: a pending request is moved straight to the
+ * done queue; an active one is finished in place and its slot freed.
+ * Partial tokens (prompt + whatever was generated) stay readable via
+ * ffs_pop_done/ffs_done_tokens. Returns 1 if the request was found and
+ * cancelled, 0 if unknown or already finished. */
+int ffs_cancel(void *handle, int64_t guid);
+
 /* Drain the done queue: returns guid and token count of the next finished
  * request, or 0 if none. */
 int ffs_pop_done(void *handle, int64_t *guid, int32_t *n_tokens);
@@ -233,11 +240,34 @@ void *ffsv_spec_create(void *cfg, const char *verifier_json,
  * maximum and the effective per-request depth adapts below it. */
 int ffsv_generate_spec(void *llm, int spec_depth);
 
-/* Register a tokenized prompt; returns the request guid, or -1. */
+/* Register a tokenized prompt; returns the request guid, or -1. When
+ * the spec JSON's generation_config sets "timeout_s" > 0, that default
+ * wall-clock bound applies to every request registered this way. */
 long ffsv_register_request(void *llm, const int32_t *tokens, int n_tokens,
                            int max_new_tokens);
+/* Register with an explicit per-request wall-clock timeout (seconds;
+ * <= 0 = none, overriding any spec-JSON default). A request past its
+ * deadline is cancelled between decode rounds: its slot is freed, the
+ * partial output stays readable via ffsv_get_output, and
+ * ffsv_request_status reports 1 (timed_out). Returns the guid, or -1. */
+long ffsv_register_request_timeout(void *llm, const int32_t *tokens,
+                                   int n_tokens, int max_new_tokens,
+                                   double timeout_s);
+/* Flag a registered request for cancellation; the next
+ * ffsv_generate/ffsv_generate_spec round reaps it (slot freed, partial
+ * output kept, status -> 2 cancelled). Works on all scheduler paths
+ * (incremental python loop, native C++ scheduler, fused speculative).
+ * Returns 1 if cancelled, 0 if unknown or already finished, -1 error. */
+int ffsv_request_cancel(void *llm, long guid);
+/* Resolution status of a request guid: -1 unknown, 0 ok (completed),
+ * 1 timed_out, 2 cancelled, 3 error, 4 registered-but-unfinished.
+ * Timed-out/cancelled requests still expose their partial tokens via
+ * ffsv_get_output / ffsv_get_output_text. */
+int ffsv_request_status(void *llm, long guid);
 /* Decode every pending request to completion (reference
- * flexflow_model_generate). Returns finished count, or -1. */
+ * flexflow_model_generate). Returns finished count, or -1. Requests
+ * whose deadline expires mid-run, or that were cancelled, count toward
+ * the finished total (they RESOLVED — check ffsv_request_status). */
 int ffsv_generate(void *llm);
 /* Fetch a finished request's output tokens; returns the full count
  * (recall with more room if it exceeds cap), or -1. */

@@ -210,6 +210,29 @@ int ffs_append_block(void *handle, const int32_t *toks, int B) {
   return finished;
 }
 
+int ffs_cancel(void *handle, int64_t guid) {
+  auto *s = static_cast<Sched *>(handle);
+  for (auto it = s->pending.begin(); it != s->pending.end(); ++it) {
+    if ((*it)->guid == guid) {
+      Req *r = *it;
+      s->pending.erase(it);
+      r->finished = true;
+      s->done.push_back(r);
+      return 1;
+    }
+  }
+  for (int slot = 0; slot < s->R; ++slot) {
+    Req *r = s->active[slot];
+    if (r && r->guid == guid && !r->finished) {
+      r->finished = true;
+      s->done.push_back(r);
+      s->active[slot] = nullptr;
+      return 1;
+    }
+  }
+  return 0;
+}
+
 int ffs_pop_done(void *handle, int64_t *guid, int32_t *n_tokens) {
   auto *s = static_cast<Sched *>(handle);
   if (s->done.empty()) return 0;

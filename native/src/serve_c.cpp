@@ -191,6 +191,48 @@ long ffsv_register_request(void *llm, const int32_t *tokens, int n_tokens,
   return guid;
 }
 
+/* Register a tokenized prompt with a per-request wall-clock timeout
+ * (seconds; <= 0 = none). Past the deadline the request is cancelled
+ * between decode rounds and resolves as timed_out with its partial
+ * output. Returns the request guid or -1. */
+long ffsv_register_request_timeout(void *llm, const int32_t *tokens,
+                                   int n_tokens, int max_new_tokens,
+                                   double timeout_s) {
+  PyObject *lst = PyList_New(n_tokens);
+  for (int i = 0; i < n_tokens; i++)
+    PyList_SetItem(lst, i, PyLong_FromLong(tokens[i]));
+  PyObject *r = call("register_request_timeout",
+                     Py_BuildValue("(ONid)", (PyObject *)llm, lst,
+                                   max_new_tokens, timeout_s));
+  if (!r) return -1;
+  long guid = PyLong_AsLong(r);
+  Py_DECREF(r);
+  return guid;
+}
+
+/* Flag a registered request for cancellation; the next generate round
+ * reaps it (slot freed, partial output kept, status -> cancelled).
+ * Returns 1 if cancelled, 0 if unknown/finished, -1 on error. */
+int ffsv_request_cancel(void *llm, long guid) {
+  PyObject *r = call("request_cancel",
+                     Py_BuildValue("(Ol)", (PyObject *)llm, guid));
+  if (!r) return -1;
+  long v = PyLong_AsLong(r);
+  Py_DECREF(r);
+  return (int)v;
+}
+
+/* Resolution status of a request: -1 unknown, 0 ok, 1 timed_out,
+ * 2 cancelled, 3 error, 4 registered-but-unfinished. */
+int ffsv_request_status(void *llm, long guid) {
+  PyObject *r = call("request_status",
+                     Py_BuildValue("(Ol)", (PyObject *)llm, guid));
+  if (!r) return -1;
+  long v = PyLong_AsLong(r);
+  Py_DECREF(r);
+  return (int)v;
+}
+
 /* Build + compile a speculative-decoding pair: verifier (tree-verify
  * mode) + draft SSM(s) (beam-search mode) — the reference's spec_infer
  * main (inference/spec_infer/spec_infer.cc:201). Both specs use the

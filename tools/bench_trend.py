@@ -62,18 +62,37 @@ HEADLINES: Dict[str, float] = {
     "bf16_acceptance_sweep[eps=0.05].speedup_vs_incr": 0.07,
     "bf16_acceptance_sweep[eps=0.2].speedup_vs_incr": 0.07,
     "bf16_acceptance_sweep[eps=1.0].speedup_vs_incr": 0.07,
+    # overload-shedding line (ISSUE 16): at 2x the measured knee the
+    # high-priority tenant's goodput and the every-future-resolves
+    # fraction must hold; both also carry absolute floors below.
+    "serving_overload.priority_goodput": 0.05,
+    "serving_overload.resolved_fraction": 0.01,
 }
 
 # Absolute floors, enforced on the LATEST round only when its bench line
-# ran with the adaptive controller (parsed["adaptive_spec"] is true) —
-# relative-to-prior gating alone cannot express the never-lose contract
-# (a first-ever or slowly-eroding sub-break-even sweep value would pass).
-# Pre-controller rounds (r01-r05) lack the marker and are not floored.
-FLOORS: Dict[str, float] = {
-    "bf16_acceptance_sweep[eps=0.05].speedup_vs_incr": 0.95,
-    "bf16_acceptance_sweep[eps=0.2].speedup_vs_incr": 0.95,
-    "bf16_acceptance_sweep[eps=1.0].speedup_vs_incr": 0.95,
+# carries the marker key guarding each group — relative-to-prior gating
+# alone cannot express an absolute contract (a first-ever or slowly-
+# eroding sub-break-even value would pass). Grouped as
+# marker-path -> {metric -> floor}: the acceptance-sweep never-lose
+# floors apply to adaptive-controller rounds (parsed["adaptive_spec"]
+# true; pre-controller r01-r05 lack the marker), the overload floors to
+# any round that ran the serving_overload section (ISSUE 16 gate:
+# priority goodput >= 0.95 at 2x knee, every future resolves).
+FLOOR_GROUPS: Dict[str, Dict[str, float]] = {
+    "adaptive_spec": {
+        "bf16_acceptance_sweep[eps=0.05].speedup_vs_incr": 0.95,
+        "bf16_acceptance_sweep[eps=0.2].speedup_vs_incr": 0.95,
+        "bf16_acceptance_sweep[eps=1.0].speedup_vs_incr": 0.95,
+    },
+    "serving_overload": {
+        "serving_overload.priority_goodput": 0.95,
+        "serving_overload.resolved_fraction": 1.0,
+    },
 }
+
+# flattened legacy view (kept: external callers/tests address it)
+FLOORS: Dict[str, float] = {
+    m: f for grp in FLOOR_GROUPS.values() for m, f in grp.items()}
 
 
 def _get_path(d: dict, path: str):
@@ -163,8 +182,10 @@ def check_trajectory(rounds: Sequence[dict],
     # absolute floors apply even to a FIRST-of-its-config round (a fresh
     # sub-break-even sweep has no prior to regress from but still fails
     # the never-lose contract)
-    if latest["parsed"].get("adaptive_spec") is True:
-        for metric, floor in sorted(FLOORS.items()):
+    for marker, floors in sorted(FLOOR_GROUPS.items()):
+        if not latest["parsed"].get(marker):
+            continue
+        for metric, floor in sorted(floors.items()):
             cur = _get_path(latest["parsed"], metric)
             if cur is None:
                 continue
@@ -174,8 +195,7 @@ def check_trajectory(rounds: Sequence[dict],
             if cur < floor:
                 regressions.append(
                     f"{metric}: r{latest['round']:02d} {cur:.4g} below "
-                    f"absolute floor {floor:.2f} (spec losing to "
-                    f"incremental — adaptive controller regression)")
+                    f"absolute floor {floor:.2f}")
     if not prior:
         lines.append("no prior same-config rounds — relative gate "
                      "passes vacuously")

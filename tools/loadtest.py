@@ -100,7 +100,19 @@ def main(argv=None):
     ap.add_argument("--prompt-lens", default="4,8,16")
     ap.add_argument("--output-lens", default="4,8,16")
     ap.add_argument("--tenants", default="default:1",
-                    help="comma list of name:weight[:deadline_s]")
+                    help="comma list of name:weight[:deadline_s[:priority]]")
+    ap.add_argument("--overload", action="store_true",
+                    help="after the sweep, drive the engine at "
+                         "--overload-mult x the measured knee behind a "
+                         "bounded admission policy and print the "
+                         "shed/goodput table (ISSUE 16 gate)")
+    ap.add_argument("--overload-mult", type=float, default=2.0)
+    ap.add_argument("--overload-requests", type=int, default=None,
+                    help="requests in the overload run (default: "
+                         "2 x --requests)")
+    ap.add_argument("--queue-cap", type=int, default=None,
+                    help="admission max_queue_depth for the overload run "
+                         "(default: 4 x slots)")
     ap.add_argument("--platform", choices=("cpu", "default"), default="cpu",
                     help="'cpu' (default) forces the CPU backend — the "
                          "harness measures scheduling, not chip speed; "
@@ -140,7 +152,8 @@ def main(argv=None):
         bits = part.split(":")
         tenants.append(TenantSpec(
             name=bits[0], weight=float(bits[1]) if len(bits) > 1 else 1.0,
-            deadline_s=float(bits[2]) if len(bits) > 2 else args.deadline))
+            deadline_s=float(bits[2]) if len(bits) > 2 else args.deadline,
+            priority=int(bits[3]) if len(bits) > 3 else 0))
 
     t0 = time.perf_counter()
     handle, vocab = build_handle(args)
@@ -152,12 +165,26 @@ def main(argv=None):
         output_lens=tuple(int(x) for x in args.output_lens.split(",")),
         tenants=tuple(tenants), vocab_size=vocab)
     rates = [args.rate * args.step_mult ** i for i in range(args.steps)]
+    overload = None
     try:
         result = sweep(handle, spec, rates, args.requests, seed=args.seed,
                        process=args.arrivals,
                        closed_concurrency=args.closed,
                        p99_ttft_bound_s=args.p99_bound,
                        timeout_s=args.timeout)
+        if args.overload:
+            from flexflow_tpu.serve.admission import AdmissionPolicy
+            from flexflow_tpu.serve.loadgen import overload_run
+
+            knee = result.get("knee_rps") or rates[0]
+            policy = AdmissionPolicy(
+                max_queue_depth=(args.queue_cap if args.queue_cap
+                                 is not None else 4 * args.slots))
+            overload = overload_run(
+                handle, spec, knee, multiple=args.overload_mult,
+                n_requests=args.overload_requests or 2 * args.requests,
+                seed=args.seed, process=args.arrivals,
+                timeout_s=args.timeout, admission=policy)
     finally:
         handle.stop_server()
         if srv is not None:
@@ -166,9 +193,27 @@ def main(argv=None):
     if result["steps"] and "per_tenant" in result["steps"][-1]:
         print("per-tenant (last step): "
               + json.dumps(result["steps"][-1]["per_tenant"]))
+    if overload is not None:
+        rep = overload["report"]
+        print(f"overload: {overload['offered_rps']:.2f} req/s "
+              f"({overload['offered_multiple']:.1f}x knee "
+              f"{overload['knee_rps']:.2f}) -> priority goodput "
+              f"{overload['priority_goodput']:.3f} "
+              f"(tenants {overload['priority_tenants']}), "
+              f"resolved {overload['resolved_fraction']:.3f}, "
+              f"best-effort shed {overload['besteffort_shed_fraction']:.3f}")
+        print(f"overload mix: ok={rep['n_ok']} rejected={rep['n_rejected']} "
+              f"timed_out={rep['n_timed_out']} "
+              f"cancelled={rep['n_cancelled']} errors={rep['n_errors']}; "
+              f"admission {json.dumps(overload['admission'])}")
+        if "per_tenant" in rep:
+            print("overload per-tenant: " + json.dumps(rep["per_tenant"]))
     if args.json:
+        out = dict(result)
+        if overload is not None:
+            out["overload"] = overload
         with open(args.json, "w") as f:
-            json.dump(result, f, indent=2)
+            json.dump(out, f, indent=2)
         print(f"# wrote {args.json}", file=sys.stderr)
     return 0
 
