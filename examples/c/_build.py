@@ -28,10 +28,12 @@ def compile_and_emit(c_basename: str, tmpdir: str) -> str:
     return ir
 
 
-def compile_and_run_serve(c_basename: str, ok_marker: str) -> str:
+def compile_and_run_serve(c_basename: str, ok_marker: str,
+                          extra_args=()) -> str:
     """Build libflexflow_tpu_serve, compile a C serving main against it
-    (plus libpython), run it with the repo root, and assert the marker.
-    Shared by run_incr_decoding.py / run_spec_infer.py."""
+    (plus libpython), run it with the repo root (plus ``extra_args``),
+    and assert the marker. Shared by run_incr_decoding.py /
+    run_spec_infer.py."""
     import sysconfig
 
     lib_dir = os.path.join(_ROOT, "native", "build")
@@ -54,7 +56,7 @@ def compile_and_run_serve(c_basename: str, ok_marker: str) -> str:
             if p)
         # the embedded interpreter honors JAX_PLATFORMS via capi_host's
         # platform override (the axon sitecustomize otherwise pins it)
-        out = subprocess.run([exe, _ROOT], check=True, env=env,
-                             capture_output=True, text=True)
+        out = subprocess.run([exe, _ROOT, *extra_args], check=True,
+                             env=env, capture_output=True, text=True)
         assert ok_marker in out.stdout, out.stdout
         return out.stdout.strip()

@@ -1,10 +1,14 @@
 """Driver for the C speculative-decoding main: build the serve library,
 compile examples/c/spec_infer.c against it, run the binary — tree
 speculation driven end-to-end from C (reference
-inference/spec_infer/spec_infer.cc through flexflow_c.cc)."""
+inference/spec_infer/spec_infer.cc through flexflow_c.cc). Also writes
+a tiny HF-layout checkpoint first and hands its path to the C main,
+which cold-starts an engine from it via the spec-JSON "checkpoint_dir"
+key with int8 quantize-on-load."""
 
 import os as _os
 import sys as _sys
+import tempfile as _tempfile
 
 _HERE = _os.path.dirname(_os.path.abspath(__file__))
 _sys.path.insert(0, _os.path.abspath(_os.path.join(_HERE, *[_os.pardir] * 2)))
@@ -14,7 +18,12 @@ from _build import compile_and_run_serve
 
 
 def top_level_task():
-    print(compile_and_run_serve("spec_infer.c", "C spec_infer OK"))
+    from flexflow_tpu.models.checkpoint_store import save_tiny_checkpoint
+
+    with _tempfile.TemporaryDirectory() as ckpt:
+        save_tiny_checkpoint("llama", ckpt)
+        print(compile_and_run_serve("spec_infer.c", "C spec_infer OK",
+                                    extra_args=(ckpt,)))
 
 
 if __name__ == "__main__":

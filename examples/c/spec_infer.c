@@ -15,9 +15,18 @@
  * losing to plain incremental decoding, engaged identically for
  * embedded C hosts and the Python stack.
  *
+ * With a second argument — a directory holding an HF-layout checkpoint
+ * (config.json + model.safetensors, as written by
+ * flexflow_tpu.models.checkpoint_store / save_tiny_checkpoint) — the
+ * example also cold-starts an incremental engine from disk through the
+ * spec-JSON "checkpoint_dir" key with "quantize":"int8"
+ * quantize-on-load: family and model config come from config.json, not
+ * the JSON, which is exactly how a C replica host rejoins a fleet after
+ * a crash.
+ *
  *   cc spec_infer.c -L../../native/build -lflexflow_tpu_serve \
  *      -lpython3.12 -o spec_infer
- *   ./spec_infer /path/to/repo
+ *   ./spec_infer /path/to/repo [/path/to/checkpoint_dir]
  */
 #include <stdio.h>
 #include <stdlib.h>
@@ -138,6 +147,36 @@ int main(int argc, char **argv) {
     return 1;
   }
   printf("cancel + timeout statuses OK\n");
+
+  /* checkpoint cold start: build from disk, config read from the
+   * checkpoint's config.json, weights int8-quantized on load */
+  if (argc > 2) {
+    char ckpt_json[1024];
+    snprintf(ckpt_json, sizeof ckpt_json,
+             "{\"checkpoint_dir\": \"%s\", \"quantize\": \"int8\"}",
+             argv[2]);
+    void *llm = ffsv_llm_create(cfg, ckpt_json);
+    if (!llm) {
+      fprintf(stderr, "checkpoint create failed: %s\n", ffsv_last_error());
+      return 1;
+    }
+    long gc = ffsv_register_request(llm, prompt, 4, 6);
+    if (gc < 0 || ffsv_generate(llm) != 1) {
+      fprintf(stderr, "checkpoint generate failed: %s\n",
+              ffsv_last_error());
+      return 1;
+    }
+    int nc = ffsv_get_output(llm, gc, out, 64);
+    if (nc <= 0) {
+      fprintf(stderr, "checkpoint output missing: %s\n", ffsv_last_error());
+      return 1;
+    }
+    printf("checkpoint request %ld ->", gc);
+    for (int i = 0; i < nc && i < 64; i++) printf(" %d", out[i]);
+    printf("\ncheckpoint cold start OK (int8 quantize-on-load)\n");
+    ffsv_release(llm);
+  }
+
   printf("C spec_infer OK\n");
   ffsv_release(pair);
   ffsv_release(cfg);

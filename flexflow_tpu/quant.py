@@ -54,6 +54,25 @@ class QuantizedWeight:
                 f"dtype={self.dtype})")
 
 
+_QTYPE_ALIASES = {"int8": "int8", "8": "int8", "q8": "int8",
+                  "int4": "int4", "4": "int4", "q4": "int4"}
+
+
+def normalize_qtype(qtype) -> Optional[str]:
+    """Canonicalize a user-facing quantization spec (spec-JSON ``quantize``
+    key, CLI flags) to ``"int8"``/``"int4"``/``None``. Unknown values fail
+    loudly — a typo silently serving fp weights would defeat the point."""
+    if qtype is None or qtype is False:
+        return None
+    q = str(qtype).strip().lower()
+    if q in ("", "none", "fp", "float", "fp32", "bf16", "off"):
+        return None
+    if q not in _QTYPE_ALIASES:
+        raise ValueError(
+            f"unknown quantization type {qtype!r}; expected int8/int4/none")
+    return _QTYPE_ALIASES[q]
+
+
 def quantize_array(w, qtype: str) -> QuantizedWeight:
     """Quantize a 2-D float array (int4 packs two rows per byte)."""
     w = jnp.asarray(w)

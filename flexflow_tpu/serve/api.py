@@ -143,6 +143,30 @@ class LLM:
             self.hf_config)
 
     # ------------------------------------------------------------------
+    @classmethod
+    def from_checkpoint(cls, checkpoint_dir: str,
+                        quantize: Optional[str] = None, **kwargs) -> "LLM":
+        """Cold-start from an on-disk HF-layout checkpoint
+        (``models/checkpoint_store.py``: config.json +
+        model.safetensors / pytorch_model.bin).
+
+        This is the disk-to-serving path replica respawn and autoscaling
+        pay for (serve/replica.py measures it as ``cold_start_s``):
+        read config -> build the family graph -> load the name-mapped
+        weights at ``compile()`` -> optionally quantize on load
+        (``quantize="int8"|"int4"``, applied right after the weights
+        land so the fp copy never lingers). Token-identical to the
+        in-memory build the checkpoint was saved from."""
+        from flexflow_tpu.models.checkpoint_store import load_checkpoint
+        from flexflow_tpu.quant import normalize_qtype
+
+        cfg_dict, state_dict = load_checkpoint(checkpoint_dir)
+        llm = cls((cfg_dict, state_dict), **kwargs)
+        llm.checkpoint_dir = checkpoint_dir
+        llm._quantize_on_load = normalize_qtype(quantize)
+        return llm
+
+    # ------------------------------------------------------------------
     def compile(self,
                 generation_config: Optional[GenerationConfig] = None,
                 max_requests_per_batch: int = 1,
@@ -195,6 +219,10 @@ class LLM:
             # 4/8-bit weight-only compression (reference --4bit/--8bit-
             # quantization flags): done post-load so scales see real weights
             self.ffmodel.quantize_weights(config.quantization_type)
+        elif getattr(self, "_quantize_on_load", None):
+            # from_checkpoint(quantize=...): same post-load compression,
+            # requested at the checkpoint door instead of FFConfig
+            self.ffmodel.quantize_weights(self._quantize_on_load)
         # stage-shard the transformer blocks over the "pipe" axis now that
         # weights are loaded (reference inference_manager.cc:91-132
         # places layer blocks per stage at model-compile time). Runs

@@ -229,6 +229,13 @@ def llm_create(cfg, spec_json: str) -> _ServingHost:
     ``{"family": "llama", "model_config": {<family Config kwargs>},
        "mode": "inc" | "spec" | "tree",
        "weights_npz": "<path>" (optional — default is seeded init),
+       "checkpoint_dir": "<dir>" (optional — cold-start from an
+       HF-layout disk checkpoint written by models/checkpoint_store.py:
+       config.json decides family AND model_config, so neither may be
+       given alongside it; mutually exclusive with weights_npz),
+       "quantize": "int8" | "int4" | "none" (optional — weight-only
+       compression applied after the weights land, the
+       quantize-on-load cold-start path),
        "generation_config": {<adaptive speculation / sampling knobs>}
        (optional — see _GEN_CFG_KEYS; e.g. {"adaptive": true,
        "spec_depth": 6, "min_spec_depth": 1, "fallback_margin": 0.95})}``
@@ -239,15 +246,45 @@ def llm_create(cfg, spec_json: str) -> _ServingHost:
     """
     import flexflow_tpu as ff
     from flexflow_tpu.ffconst import CompMode, InferenceMode
+    from flexflow_tpu.quant import normalize_qtype
 
     spec = json.loads(spec_json)
     gen_cfg = _parse_generation_config(spec)
-    family = spec.get("family", "llama")
-    if family not in _families():
-        raise ValueError(f"unknown model family {family!r}; "
-                         f"have {sorted(_families())}")
-    cfg_cls, create = _families()[family]
-    mcfg = cfg_cls(**spec.get("model_config", {}))
+    qtype = normalize_qtype(spec.get("quantize"))   # typos fail loudly
+    ckpt_dir = spec.get("checkpoint_dir")
+    if ckpt_dir:
+        # the checkpoint's config.json IS the model spec: deriving family
+        # + model_config from anywhere else could silently build a graph
+        # the weights don't fit
+        from flexflow_tpu.models import family_for_hf_config
+        from flexflow_tpu.models.checkpoint_store import \
+            read_checkpoint_config
+
+        if spec.get("model_config"):
+            raise ValueError("checkpoint_dir and model_config are mutually "
+                             "exclusive: the checkpoint's config.json is "
+                             "the model config")
+        if spec.get("weights_npz"):
+            raise ValueError(
+                "checkpoint_dir and weights_npz are mutually exclusive")
+        cfg_dict = read_checkpoint_config(ckpt_dir)
+        fam = family_for_hf_config(cfg_dict)
+        # the C-ABI wire name for gpt_bigcode is "starcoder"
+        wire = "starcoder" if fam.name == "gpt_bigcode" else fam.name
+        if "family" in spec and spec["family"] not in (fam.name, wire):
+            raise ValueError(
+                f"spec family {spec['family']!r} does not match checkpoint "
+                f"model_type {cfg_dict.get('model_type')!r} ({wire})")
+        family = wire
+        cfg_cls, create = _families()[family]
+        mcfg = cfg_cls.from_hf_config(cfg_dict)
+    else:
+        family = spec.get("family", "llama")
+        if family not in _families():
+            raise ValueError(f"unknown model family {family!r}; "
+                             f"have {sorted(_families())}")
+        cfg_cls, create = _families()[family]
+        mcfg = cfg_cls(**spec.get("model_config", {}))
     mode = {"inc": InferenceMode.INC_DECODING_MODE,
             "spec": InferenceMode.BEAM_SEARCH_MODE,
             "tree": InferenceMode.TREE_VERIFY_MODE}[spec.get("mode", "inc")]
@@ -261,11 +298,18 @@ def llm_create(cfg, spec_json: str) -> _ServingHost:
     model = ff.FFModel(cfg)
     create(model, mcfg, mode)
     model.compile(comp_mode=CompMode.COMP_MODE_INFERENCE)
-    weights = spec.get("weights_npz")
-    if weights:
-        from flexflow_tpu.training.checkpoint import load_weights_npz
+    if ckpt_dir:
+        from flexflow_tpu.models.checkpoint_store import load_checkpoint_into
 
-        load_weights_npz(weights, model)
+        load_checkpoint_into(model, ckpt_dir, quantize=qtype)
+    else:
+        weights = spec.get("weights_npz")
+        if weights:
+            from flexflow_tpu.training.checkpoint import load_weights_npz
+
+            load_weights_npz(weights, model)
+        if qtype:
+            model.quantize_weights(qtype)
     return _ServingHost(model, gen_cfg=gen_cfg)
 
 

@@ -124,7 +124,28 @@ class FaultInjector:
 
 def check_invariants(handle) -> List[str]:
     """Leak audit after a (chaotic) serving run. Returns human-readable
-    problem strings; empty list = slot table / shadow / waiters clean."""
+    problem strings; empty list = slot table / shadow / waiters clean.
+
+    Accepts a single engine handle or a replica pool: anything exposing
+    ``replicas`` (serve/replica.py) is audited per live replica — each
+    surviving engine's slot tables and shadow must be clean, plus the
+    pool's own entry table and waiter list — with problem strings
+    prefixed by the replica id."""
+    reps = getattr(handle, "replicas", None)
+    if reps is not None:
+        problems = []
+        for rep in reps:
+            if not (rep.alive and rep.handle is not None):
+                continue
+            problems.extend(f"replica {rep.id}: {p}"
+                            for p in check_invariants(rep.handle))
+        if getattr(handle, "_entries", None):
+            problems.append(
+                f"pool: {len(handle._entries)} entry(ies) still tracked")
+        if getattr(handle, "_waiters", None):
+            problems.append(
+                f"pool: {len(handle._waiters)} unreleased waiter(s)")
+        return problems
     problems = []
     rm = handle.rm
     if rm.pending:

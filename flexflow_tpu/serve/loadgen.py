@@ -59,6 +59,7 @@ __all__ = [
     "poisson_arrivals",
     "uniform_arrivals",
     "summarize",
+    "attribute_failover_wait",
     "overload_run",
     "find_knee",
     "sweep",
@@ -245,6 +246,9 @@ class RequestRecord:
     # every-future-resolves invariant), so nothing disappears from the
     # accounting denominators.
     status: str = "ok"
+    # times the request was re-dispatched to a surviving replica after a
+    # crash (serve/replica.py); 0 on a single-engine run
+    failovers: int = 0
 
     @property
     def finished_s(self) -> float:
@@ -357,7 +361,8 @@ class LoadRunner:
                 output_tokens=len(res.output_tokens),
                 latency_s=res.latency_s, ttft_s=res.ttft_s,
                 queue_wait_s=res.queue_wait_s, prefill_s=res.prefill_s,
-                deadline_s=req.deadline_s, status=res.status))
+                deadline_s=req.deadline_s, status=res.status,
+                failovers=getattr(res, "failovers", 0)))
         records.extend(records_rejected)
         records.sort(key=lambda r: r.idx)
         return records
@@ -370,6 +375,32 @@ class LoadRunner:
 def _pcts(values, lo=50, hi=99):
     srt = sorted(values)
     return percentile(srt, lo), percentile(srt, hi)
+
+
+def attribute_failover_wait(pool_latency_s: float, final_latency_s: float,
+                            final_queue_wait_s: float,
+                            final_prefill_s: float = 0.0):
+    """Split a failed-over request's pool-level latency into
+    (queue_wait_s, ttft_s).
+
+    A request that crashed mid-flight and was re-dispatched spends its
+    life in three places: queued/served on the dead replica (work that
+    was THROWN AWAY), queued on the survivor, and finally served on the
+    survivor. Only the LAST service counts as service time — everything
+    before the survivor's slot grant is wait, else per-replica p99
+    service times would absorb crash recovery and stop meaning "how fast
+    does a healthy replica serve" (the seam ``summarize()``'s
+    queue-wait/service split is built on).
+
+    Pure arithmetic on already-measured durations (unit-tested on a fake
+    clock): the survivor's own service time is
+    ``final_latency_s - final_queue_wait_s``; all remaining pool time is
+    attributed to queue wait, and TTFT restarts with the survivor's
+    re-prefill."""
+    service_s = max(0.0, final_latency_s - final_queue_wait_s)
+    queue_wait_s = max(0.0, pool_latency_s - service_s)
+    ttft_s = queue_wait_s + max(0.0, final_prefill_s)
+    return queue_wait_s, ttft_s
 
 
 def summarize(records: Sequence[RequestRecord],
@@ -425,6 +456,12 @@ def summarize(records: Sequence[RequestRecord],
         "n_timed_out": n_by.get("timed_out", 0),
         "n_cancelled": n_by.get("cancelled", 0),
         "n_errors": n_by.get("error", 0),
+        # crash-failover visibility: how many served requests were
+        # re-dispatched at least once, and the total re-dispatch count
+        # (their wait is attributed to queue_wait_s by the pool via
+        # attribute_failover_wait, so the service split stays honest)
+        "n_failed_over": sum(r.failovers > 0 for r in recs),
+        "failovers_total": sum(r.failovers for r in recs),
         "resolved_fraction": (round(len(recs) / n_scheduled, 4)
                               if n_scheduled else 1.0),
         "duration_s": round(duration_s, 4),

@@ -84,6 +84,11 @@ class Request:
     error: str = ""
     cancel_requested: bool = False
     preemptions: int = 0
+    # fleet failover (serve/replica.py): how many times this request was
+    # re-dispatched to a surviving replica after an engine crash (the
+    # pool re-registers the prompt, so a replica-level Request usually
+    # carries the count it was re-created with)
+    failovers: int = 0
 
     def __post_init__(self):
         if not self.tokens:
@@ -129,6 +134,9 @@ class GenerationResult:
     error: str = ""
     tenant: str = "default"
     preemptions: int = 0
+    # times the request was re-dispatched to another replica after a
+    # crash (serve/replica.py failover; re-prefilled, token-identical)
+    failovers: int = 0
 
 
 class RequestManager:
@@ -275,7 +283,8 @@ class RequestManager:
             if req.first_token_s and req.prefill_start_s else 0.0,
             status=req.status, timed_out=req.status == "timed_out",
             cancelled=req.status == "cancelled", error=req.error,
-            tenant=req.tenant, preemptions=req.preemptions)
+            tenant=req.tenant, preemptions=req.preemptions,
+            failovers=req.failovers)
         self.inflight.pop(req.guid, None)
         tel = self._tel()
         if tel is not None:

@@ -12,6 +12,17 @@ Design: FFModel keeps all mutable state in jax pytrees (``params``,
 ``opt_state``, ``op_state``), so a checkpoint is just those pytrees plus a
 small metadata dict. Orbax restores arrays with their NamedSharding layouts
 onto the model's mesh automatically (restore_args built from the live model).
+
+This module is the TRAINING-side store: full mutable state (params +
+optimizer + rng + dataloader cursor), orbax layout, resume-bit-identical.
+The SERVING-side store is :mod:`flexflow_tpu.models.checkpoint_store`:
+weights only, HF directory layout (config.json + model.safetensors /
+pytorch_model.bin with the zoo's HF tensor names), readable without orbax
+or this module, with optional int8/int4 quantize-on-load — that is what
+replica cold start, ``LLM.from_checkpoint``, and the C API's
+``checkpoint_dir`` spec key consume. Bridge between the two worlds via
+:func:`save_weights_npz` below or ``checkpoint_store.save_checkpoint`` on
+a live model; see README "Checkpoints".
 """
 
 from __future__ import annotations

@@ -204,7 +204,17 @@ char *ffsv_config_get(void *cfg, const char *key);   /* caller frees */
  * {"family":"llama|opt|falcon|mpt|starcoder",
  *  "model_config":{...family Config kwargs...},
  *  "mode":"inc|spec|tree", "weights_npz":"path" (optional),
+ *  "checkpoint_dir":"path" (optional), "quantize":"int8|int4" (optional),
  *  "generation_config":{...} (optional)}
+ *
+ * "checkpoint_dir" cold-starts the model from an HF-layout disk
+ * checkpoint (config.json + model.safetensors or pytorch_model.bin, as
+ * written by flexflow_tpu.models.checkpoint_store): the family and
+ * model_config are read from config.json — supplying "model_config" or
+ * "weights_npz" alongside it is an error, and an explicit "family" must
+ * agree with the checkpoint. "quantize" compresses the weights to int8
+ * or int4 on load (quantize-on-load; works with either weight source),
+ * token-identical to quantizing the same weights in memory.
  *
  * generation_config keys (all optional; defaults in parentheses) drive
  * the adaptive speculation controller — the same per-request depth

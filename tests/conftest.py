@@ -29,7 +29,8 @@ import pytest  # noqa: E402
 # the front of the run: they share one tiny session-scoped spec pair and
 # finish in seconds, so the reordering costs the heavier files nothing.
 _EARLY_FILES = ("test_loadgen.py", "test_telemetry.py",
-                "test_spec_controller.py", "test_overload.py")
+                "test_spec_controller.py", "test_overload.py",
+                "test_fleet.py")
 
 
 def pytest_collection_modifyitems(session, config, items):

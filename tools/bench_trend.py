@@ -67,6 +67,18 @@ HEADLINES: Dict[str, float] = {
     # fraction must hold; both also carry absolute floors below.
     "serving_overload.priority_goodput": 0.05,
     "serving_overload.resolved_fraction": 0.01,
+    # fleet line (ISSUE 17): crash chaos must keep resolving everything
+    "serving_fleet.resolved_fraction": 0.01,
+}
+
+# Lower-is-better headlines: metric -> relative RISE tolerance (fail when
+# the latest round exceeds the best — i.e. LOWEST — prior same-config
+# value by more than this fraction). Cold start is a wall-clock
+# build+load+jit measurement on shared CPU hosts, hence the wide band —
+# the gate is for a structural regression (e.g. the weight loader going
+# quadratic), not scheduler jitter.
+LOWER_IS_BETTER: Dict[str, float] = {
+    "serving_fleet.cold_start_s": 0.60,
 }
 
 # Absolute floors, enforced on the LATEST round only when its bench line
@@ -87,6 +99,11 @@ FLOOR_GROUPS: Dict[str, Dict[str, float]] = {
     "serving_overload": {
         "serving_overload.priority_goodput": 0.95,
         "serving_overload.resolved_fraction": 1.0,
+    },
+    # ISSUE 17: under seeded replica-crash chaos every submitted future
+    # must still resolve (failover re-dispatch, token-identical)
+    "serving_fleet": {
+        "serving_fleet.resolved_fraction": 1.0,
     },
 }
 
@@ -168,7 +185,9 @@ def check_trajectory(rounds: Sequence[dict],
     either side are skipped (sections appear over time — the gate only
     ever compares like with like)."""
     tol = dict(HEADLINES)
-    tol.update(tolerances or {})
+    low_tol = dict(LOWER_IS_BETTER)
+    for k, v in (tolerances or {}).items():
+        (low_tol if k in low_tol else tol)[k] = v
     ok_rounds = [r for r in rounds if r["ok"]]
     lines = []
     if not ok_rounds:
@@ -222,12 +241,37 @@ def check_trajectory(rounds: Sequence[dict],
                 f"{metric}: r{latest['round']:02d} {cur:.4g} vs best "
                 f"r{best_round:02d} {best:.4g} "
                 f"({-drop * 100:+.1f}% > -{t * 100:.0f}% tolerance)")
+    # lower-is-better metrics (cold start): best prior = MINIMUM, fail
+    # when the latest round RISES beyond its tolerance
+    for metric, t in sorted(low_tol.items()):
+        cur = _get_path(latest["parsed"], metric)
+        if cur is None:
+            continue
+        best, best_round = None, None
+        for r in prior:
+            v = _get_path(r["parsed"], metric)
+            if v is not None and (best is None or v < best):
+                best, best_round = v, r["round"]
+        if best is None or best <= 0:
+            continue
+        rise = (cur - best) / best
+        tag = "REGRESSION" if rise > t else "ok"
+        lines.append(
+            f"  {tag:>10}  {metric:<40} {cur:>10.4g}  vs best "
+            f"r{best_round:02d} {best:.4g}  ({rise * 100:+.1f}%, "
+            f"tol +{t * 100:.0f}%, lower is better)")
+        if rise > t:
+            regressions.append(
+                f"{metric}: r{latest['round']:02d} {cur:.4g} vs best "
+                f"r{best_round:02d} {best:.4g} "
+                f"({rise * 100:+.1f}% > +{t * 100:.0f}% tolerance, "
+                f"lower is better)")
     return regressions, lines
 
 
 def trend_table(rounds: Sequence[dict]) -> str:
     """Round-by-round values of every headline metric present anywhere."""
-    metrics = [m for m in HEADLINES
+    metrics = [m for m in (*HEADLINES, *LOWER_IS_BETTER)
                if any(_get_path(r["parsed"], m) is not None for r in rounds)]
     w = max((len(m) for m in metrics), default=6)
     head = "metric".ljust(w) + "".join(

@@ -71,6 +71,104 @@ def build_handle(args):
     return EngineHandle(build(InferenceMode.INC_DECODING_MODE)), vocab
 
 
+def _write_fleet_checkpoint(args):
+    """Build one model at the CLI geometry and save it as the fleet's
+    HF-layout disk checkpoint (reused if the dir already holds one)."""
+    import tempfile
+
+    from flexflow_tpu.models.checkpoint_store import (CONFIG_NAME,
+                                                      save_checkpoint)
+
+    ckpt = args.checkpoint_dir or tempfile.mkdtemp(prefix="fleet_ckpt_")
+    if os.path.exists(os.path.join(ckpt, CONFIG_NAME)):
+        return ckpt
+    import flexflow_tpu as ff
+    from flexflow_tpu.ffconst import InferenceMode
+    from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+
+    vocab, hidden, inter, layers, heads, kv, max_seq = \
+        GEOMETRIES[args.geometry]
+    mcfg = LLAMAConfig(vocab_size=vocab, hidden_size=hidden,
+                       intermediate_size=inter, num_hidden_layers=layers,
+                       num_attention_heads=heads, num_key_value_heads=kv,
+                       max_position_embeddings=max_seq)
+    cfg = ff.FFConfig(max_requests_per_batch=2, max_sequence_length=max_seq,
+                      max_tokens_per_batch=16, seed=args.seed,
+                      kv_cache_dtype="float32")
+    model = ff.FFModel(cfg)
+    create_llama_model(model, mcfg, mode=InferenceMode.INC_DECODING_MODE)
+    model.compile(comp_mode=ff.CompMode.COMP_MODE_INFERENCE)
+    save_checkpoint(model, "llama", mcfg, ckpt)
+    return ckpt
+
+
+def _spike_main(args, tenants):
+    """--spike: checkpoint -> pool -> (optional crash) -> base/spike run
+    with the queue-triggered autoscaler."""
+    from flexflow_tpu.serve.loadgen import WorkloadSpec
+    from flexflow_tpu.serve.replica import (ReplicaPool,
+                                            checkpoint_replica_factory,
+                                            failover_run, spike_run)
+
+    vocab, _, _, _, _, _, max_seq = GEOMETRIES[args.geometry]
+    t0 = time.perf_counter()
+    ckpt = _write_fleet_checkpoint(args)
+    print(f"# fleet checkpoint at {ckpt} "
+          f"({time.perf_counter() - t0:.1f}s)", file=sys.stderr)
+    spec = WorkloadSpec(
+        prompt_lens=tuple(int(x) for x in args.prompt_lens.split(",")),
+        output_lens=tuple(int(x) for x in args.output_lens.split(",")),
+        tenants=tenants, vocab_size=vocab)
+    factory = checkpoint_replica_factory(ckpt, slots=args.slots,
+                                         max_seq=max_seq,
+                                         quantize=args.quantize,
+                                         seed_base=7000 + args.seed)
+    pool = ReplicaPool(factory, n_replicas=args.replicas)
+    t0 = time.perf_counter()
+    pool.start_server()
+    starts = pool.stats()["cold_starts_s"]
+    print(f"# pool up: {args.replicas} replica(s) in "
+          f"{time.perf_counter() - t0:.1f}s, cold starts {starts}",
+          file=sys.stderr)
+    out = {"checkpoint_dir": ckpt, "quantize": args.quantize,
+           "initial_cold_starts_s": starts}
+    try:
+        if args.crash_after > 0:
+            fo = failover_run(pool, spec, rate_rps=args.rate,
+                              n_requests=args.requests, seed=args.seed,
+                              crash_after=args.crash_after,
+                              process=args.arrivals,
+                              timeout_s=args.timeout)
+            out["failover"] = fo
+            print(f"crash: replica 0 after {args.crash_after} calls -> "
+                  f"resolved {fo['resolved_fraction']:.3f}, "
+                  f"{fo['n_failed_over']} failed over "
+                  f"({fo['failovers_total']} re-dispatches), recovery "
+                  f"{fo['failover_recovery_s']}s, respawn cold start "
+                  f"{fo['cold_start_s']}s")
+        sp = spike_run(pool, spec, base_rps=args.rate,
+                       spike_multiple=args.spike_mult,
+                       n_base=args.requests, n_spike=2 * args.requests,
+                       seed=args.seed, process=args.arrivals,
+                       timeout_s=args.timeout)
+        out["spike"] = sp
+        print(f"spike: {sp['base_rps']:.2f} -> {sp['spike_rps']:.2f} req/s; "
+              f"scaled_up={sp['scaled_up']} "
+              f"(trigger at {sp['scale_trigger_s']}s, outstanding >= "
+              f"{sp['scale_threshold']}), cold_start_s={sp['cold_start_s']}, "
+              f"slo_violation_s={sp['slo_violation_s']}")
+        print(f"spike phase: resolved {sp['spike']['resolved_fraction']:.3f}, "
+              f"lat p99 {sp['spike']['latency_p99_s']}s, replicas "
+              f"{sp['n_replicas_before']} -> {sp['n_replicas_after']}")
+    finally:
+        pool.stop_server()
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=2)
+        print(f"# wrote {args.json}", file=sys.stderr)
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="closed-loop serving load harness with SLO knee sweep")
@@ -107,6 +205,28 @@ def main(argv=None):
                          "bounded admission policy and print the "
                          "shed/goodput table (ISSUE 16 gate)")
     ap.add_argument("--overload-mult", type=float, default=2.0)
+    ap.add_argument("--spike", action="store_true",
+                    help="fleet mode (ISSUE 17): serve a replica pool "
+                         "cold-started from a disk checkpoint, optionally "
+                         "crash one replica mid-run (--crash-after), then "
+                         "drive a base->spike traffic step; an autoscaler "
+                         "spins up a replica at the MEASURED cold-start "
+                         "delay and the report shows cold_start_s + "
+                         "SLO-violation-seconds during scale-out")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="initial pool size for --spike")
+    ap.add_argument("--spike-mult", type=float, default=8.0,
+                    help="spike rate = --rate x this")
+    ap.add_argument("--quantize", default=None,
+                    help="quantize-on-load for --spike replicas "
+                         "(int8 | int4)")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="reuse/write the fleet checkpoint here "
+                         "(default: a temp dir)")
+    ap.add_argument("--crash-after", type=int, default=0, metavar="N",
+                    help="with --spike: before the spike, crash replica 0 "
+                         "on its N-th engine call and report the failover "
+                         "(0 = no crash)")
     ap.add_argument("--overload-requests", type=int, default=None,
                     help="requests in the overload run (default: "
                          "2 x --requests)")
@@ -154,6 +274,14 @@ def main(argv=None):
             name=bits[0], weight=float(bits[1]) if len(bits) > 1 else 1.0,
             deadline_s=float(bits[2]) if len(bits) > 2 else args.deadline,
             priority=int(bits[3]) if len(bits) > 3 else 0))
+
+    if args.spike:
+        spec_tenants = tuple(tenants)
+        try:
+            return _spike_main(args, spec_tenants)
+        finally:
+            if srv is not None:
+                srv.stop()
 
     t0 = time.perf_counter()
     handle, vocab = build_handle(args)
