@@ -424,7 +424,9 @@ class _BackgroundServer:
 
     def submit(self, prompts, max_new_tokens: int, max_length: int,
                timeout_s: Optional[float] = None, tenant: str = "default",
-               priority: int = 0) -> Tuple[List[int], threading.Event]:
+               priority: int = 0, trace_id: Optional[str] = None,
+               failovers: int = 0, preemptions: int = 0
+               ) -> Tuple[List[int], threading.Event]:
         ev = threading.Event()
         with self._work:
             if self._error is not None:
@@ -446,7 +448,9 @@ class _BackgroundServer:
             guids = [self.llm.rm.register_new_request(
                 p, max_new_tokens=max_new_tokens,
                 max_sequence_length=max_length, timeout_s=timeout_s,
-                tenant=tenant, priority=priority) for p in prompts]
+                tenant=tenant, priority=priority, trace_id=trace_id,
+                failovers=failovers, preemptions=preemptions)
+                for p in prompts]
             self._waiters.append((set(guids), ev))
             self._work.notify_all()
         return guids, ev

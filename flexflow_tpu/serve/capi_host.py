@@ -455,24 +455,27 @@ def register_request_text(host: _ServingHost, text: str,
 
 
 def metrics_dump(fmt: str = "json") -> str:
-    """Snapshot the global telemetry registry (``ffsv_metrics_dump``).
+    """Process-wide aggregated metrics snapshot (``ffsv_metrics_dump``).
 
-    ``fmt``: "json" (structured snapshot incl. exact p50/p90/p99 per
-    histogram) or "prometheus" (text exposition format). Returns an
-    EMPTY snapshot ("{}" / "") when telemetry is disabled — a C host can
+    Merges the global telemetry registry with every live replica pool's
+    per-replica registries (``telemetry.aggregate_registry`` — exact by
+    MetricsRegistry.merge's contract), so a C host sees fleet totals
+    without knowing about pools. ``fmt``: "json" (structured snapshot
+    incl. exact p50/p90/p99 per histogram) or "prometheus" (text
+    exposition format). Returns an EMPTY snapshot ("{}" / "") when
+    telemetry is disabled and no fleet is live — a C host can
     distinguish "off" from "on with no traffic" by the presence of the
     ffsv_requests_total key. Unknown formats raise (surfaces as NULL +
     ffsv_last_error)."""
-    from flexflow_tpu.telemetry import get_telemetry
+    from flexflow_tpu.telemetry import aggregate_registry, get_telemetry
 
     if fmt not in ("json", "prometheus"):
         raise ValueError(f"unknown metrics format {fmt!r}; "
                          "use 'json' or 'prometheus'")
-    tel = get_telemetry()
-    if tel is None:
+    reg = aggregate_registry()
+    if get_telemetry() is None and not reg.snapshot():
         return "{}" if fmt == "json" else ""
-    return (tel.registry.to_json() if fmt == "json"
-            else tel.registry.to_prometheus())
+    return reg.to_json() if fmt == "json" else reg.to_prometheus()
 
 
 def get_output_text(host: _ServingHost, request_id: int) -> str:

@@ -296,6 +296,11 @@ class MultiSpecEngine:
         self._block = jax.jit(
             self._block_impl,
             donate_argnums=(1,) + tuple(3 + 2 * i for i in range(nssm)))
+        # jit-cache accounting: _block_impl's python body runs ONLY when
+        # XLA (re)traces, so _trace_count is the compile count; run_block
+        # reports new traces past the first as retraces (note_retrace)
+        self._trace_count = 0
+        self._traces_reported = 0
         self._rng_const = jax.random.PRNGKey(llm.config.seed)
 
     # -- static tree topology: root + B unmerged chains ----------------
@@ -477,6 +482,7 @@ class MultiSpecEngine:
                 n_acc, bonus)
 
     def _block_impl(self, llm_params, llm_state, *rest):
+        self._trace_count += 1          # python body == one XLA trace
         B = len(self.ssms)
         ssm_ps = [rest[2 * i] for i in range(B)]
         ssm_states = [rest[2 * i + 1] for i in range(B)]
@@ -583,6 +589,11 @@ class MultiSpecEngine:
             tel.record_spec_block(time.perf_counter() - t0,
                                   packed[:, :, -2], self.depth,
                                   self.tree_width, depths=packed[:, :, -1])
+            if self._trace_count != self._traces_reported:
+                tel.note_retrace("MultiSpecEngine",
+                                 self._trace_count - self._traces_reported,
+                                 self._trace_count)
+                self._traces_reported = self._trace_count
         return packed[:, :, :-2], packed[:, :, -2], packed[:, :, -1]
 
 
@@ -609,6 +620,9 @@ class SpecChainEngine:
         self.telemetry = None   # explicit ServingTelemetry; None -> global
         self._compute_dtype = jnp.dtype(llm.config.compute_dtype)
         self._block = jax.jit(self._block_impl, donate_argnums=(1, 3))
+        # jit-cache accounting (see MultiSpecEngine.__init__)
+        self._trace_count = 0
+        self._traces_reported = 0
         # concrete (created outside any trace: jit closes over it as a const)
         self._rng_const = jax.random.PRNGKey(llm.config.seed)
 
@@ -670,6 +684,7 @@ class SpecChainEngine:
     def _block_impl(self, llm_params, llm_state, ssm_params, ssm_state, tok,
                     pos, active, n_rounds, remaining, depth0, min_depth,
                     adaptive):
+        self._trace_count += 1          # python body == one XLA trace
         R = tok.shape[0]
         d = self.depth
         max_seq = self.llm.config.max_sequence_length
@@ -774,6 +789,11 @@ class SpecChainEngine:
             tel.record_spec_block(time.perf_counter() - t0,
                                   packed[:, :, -2], self.depth,
                                   self.depth + 1, depths=packed[:, :, -1])
+            if self._trace_count != self._traces_reported:
+                tel.note_retrace("SpecChainEngine",
+                                 self._trace_count - self._traces_reported,
+                                 self._trace_count)
+                self._traces_reported = self._trace_count
         return packed[:, :, :-2], packed[:, :, -2], packed[:, :, -1]
 
 
@@ -831,6 +851,9 @@ class BeamSpecEngine:
             nd[1 + t * width: 1 + (t + 1) * width] = t + 1
         self._depth_of = jnp.asarray(nd)
         self._block = jax.jit(self._block_impl, donate_argnums=(1, 3))
+        # jit-cache accounting (see MultiSpecEngine.__init__)
+        self._trace_count = 0
+        self._traces_reported = 0
         self._rng_const = jax.random.PRNGKey(llm.config.seed)
 
     def _select(self, cand, ids_flat, par_flat):
@@ -1014,6 +1037,7 @@ class BeamSpecEngine:
     def _block_impl(self, llm_params, llm_state, ssm_params, ssm_state,
                     tok, pos, active, n_rounds, remaining, depth0,
                     min_depth, adaptive):
+        self._trace_count += 1          # python body == one XLA trace
         R = tok.shape[0]
         d = self.depth
         max_seq = self.llm.config.max_sequence_length
@@ -1104,4 +1128,9 @@ class BeamSpecEngine:
             tel.record_spec_block(time.perf_counter() - t0,
                                   packed[:, :, -2], self.depth,
                                   self.tree_width, depths=packed[:, :, -1])
+            if self._trace_count != self._traces_reported:
+                tel.note_retrace("BeamSpecEngine",
+                                 self._trace_count - self._traces_reported,
+                                 self._trace_count)
+                self._traces_reported = self._trace_count
         return packed[:, :, :-2], packed[:, :, -2], packed[:, :, -1]

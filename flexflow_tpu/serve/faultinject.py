@@ -278,7 +278,19 @@ def run_chaos(handle, n_requests: int = 16, seed: int = 0,
         problems.append(
             f"{by_status['unresolved']} future(s) unresolved within "
             f"{resolve_bound_s}s")
+    # flight-recorder contract (pool handles): every crash the monitor
+    # detected must have produced a PARSEABLE incident report
+    incident_reports = list(getattr(handle, "incident_reports", None) or ())
+    if incident_reports:
+        from flexflow_tpu.telemetry.flight_recorder import \
+            load_incident_report
+        for path in incident_reports:
+            try:
+                load_incident_report(path)
+            except (OSError, ValueError) as err:
+                problems.append(f"incident report {path}: {err}")
     return {
+        "incident_reports": incident_reports,
         "n_requests": n_requests,
         "statuses": by_status,
         "resolved_fraction": round(

@@ -571,7 +571,7 @@ def sweep(handle, spec: WorkloadSpec, rates: Sequence[float],
 def overload_run(handle, spec: WorkloadSpec, knee_rps: float,
                  multiple: float = 2.0, n_requests: int = 32, seed: int = 0,
                  process: str = "poisson", timeout_s: float = 300.0,
-                 admission=None) -> dict:
+                 admission=None, slo_policy=None) -> dict:
     """Drive the engine PAST its measured knee and report how it sheds.
 
     Offered load is ``multiple`` x ``knee_rps`` (the ISSUE/bench gate
@@ -609,6 +609,11 @@ def overload_run(handle, spec: WorkloadSpec, knee_rps: float,
     shed = [r for r in rest if r.status != "ok"]
     ctrl = admission if admission is not None else \
         getattr(getattr(handle, "_server", None), "admission", None)
+    # structured burn-rate alert timeline over the run's own record
+    # clock (telemetry/slo.py) — what an operator would have been paged
+    # with while the engine shed load
+    from flexflow_tpu.telemetry.slo import replay_records
+    slo = replay_records(records, policy=slo_policy).report()
     return {
         "knee_rps": float(knee_rps),
         "offered_multiple": float(multiple),
@@ -621,6 +626,7 @@ def overload_run(handle, spec: WorkloadSpec, knee_rps: float,
         "besteffort_shed_fraction": (round(len(shed) / len(rest), 4)
                                      if rest else 0.0),
         "admission": ctrl.stats() if ctrl is not None else None,
+        "slo": slo,
         "report": report,
     }
 

@@ -49,6 +49,7 @@ Design points:
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
 from typing import Callable, List, Optional, Sequence
@@ -58,6 +59,7 @@ from flexflow_tpu.serve.loadgen import (LoadRunner, WorkloadSpec,
                                         build_schedule, summarize)
 from flexflow_tpu.serve.request_manager import (GenerationResult,
                                                 RequestManager)
+from flexflow_tpu.telemetry import mint_trace_id
 
 __all__ = [
     "Replica",
@@ -169,6 +171,11 @@ class _Entry:
     finished: bool = False
     retry_pending: bool = True         # no live dispatch yet
     cancel_requested: bool = False
+    # fleet-wide correlation id, minted ONCE at the pool door; every
+    # (re)dispatch registers it on the target replica, so the request's
+    # spans on a crashed replica and on its failover survivor join under
+    # the same id in the stitched Chrome trace
+    trace_id: str = ""
 
 
 class _PendingProxy:
@@ -228,12 +235,21 @@ class ReplicaPool:
     started); the pool measures every factory call as that replica's
     ``cold_start_s``. ``admission`` is the SHARED front-door controller
     (an ``AdmissionPolicy`` or ``AdmissionController``); replicas run
-    admission-free behind it."""
+    admission-free behind it.
+
+    ``telemetry`` is a
+    :class:`~flexflow_tpu.telemetry.fleet.FleetTelemetry`: each replica's
+    RequestManager gets its per-replica ServingTelemetry (own Chrome-trace
+    pid row, registry, flight-recorder ring) BEFORE its server starts,
+    and on a crash the monitor dumps the dead replica's flight ring as an
+    incident report under ``incident_dir`` (defaults to the fleet's
+    ``trace_dir``), appending the path to ``incident_reports``."""
 
     def __init__(self, factory: Callable, n_replicas: int = 2,
                  admission=None, max_failovers: int = 3,
                  respawn: bool = True, poll_interval_s: float = 0.002,
-                 clock=time.perf_counter):
+                 clock=time.perf_counter, telemetry=None,
+                 incident_dir: Optional[str] = None):
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         self._factory = factory
@@ -259,12 +275,18 @@ class ReplicaPool:
         self._failover_events: List[dict] = []
         self._failovers_total = 0
         self._dirty_shutdowns = 0
+        self.telemetry = telemetry     # FleetTelemetry (or None: untraced)
+        self.incident_dir = incident_dir
+        self.incident_reports: List[str] = []
+        self._incident_seq = 0
 
     # -- lifecycle ----------------------------------------------------------
 
     def _build_replica(self, rep: Replica):
         t0 = self._clock()
         handle = self._factory(rep.id)
+        if self.telemetry is not None:
+            handle.rm.telemetry = self.telemetry.for_replica(rep.id)
         handle.start_server()          # admission=None: pool door decides
         rep.cold_start_s = self._clock() - t0
         self._cold_starts.append(rep.cold_start_s)
@@ -286,6 +308,9 @@ class ReplicaPool:
             if rep.handle is None:
                 self._build_replica(rep)
             elif rep.server is None:
+                if self.telemetry is not None:
+                    rep.handle.rm.telemetry = \
+                        self.telemetry.for_replica(rep.id)
                 rep.handle.start_server()
                 rep.alive = True
         self._stopping = False
@@ -385,7 +410,7 @@ class ReplicaPool:
         e = _Entry(guid=next(RequestManager._guid_counter), prompt=prompt,
                    max_new_tokens=max_new_tokens, max_length=max_length,
                    tenant=tenant, priority=priority, t_submit=now,
-                   deadline=deadline)
+                   deadline=deadline, trace_id=mint_trace_id())
         self._entries[e.guid] = e
         self.rm.inflight[e.guid] = e
         # whole fleet down (mid-respawn): the entry buffers at the pool
@@ -401,6 +426,8 @@ class ReplicaPool:
         buffered with ``retry_pending``."""
         remaining = (None if e.deadline is None
                      else max(0.01, e.deadline - now))
+        redispatch = e.cur_guid is not None
+        prev_id = e.replica.id if e.replica is not None else -1
         for _ in range(max(1, len(self.replicas))):
             target = self._pick_replica(exclude=exclude)
             if target is None:
@@ -414,17 +441,22 @@ class ReplicaPool:
                 rg, _ = target.handle._server.submit(
                     [e.prompt], e.max_new_tokens, e.max_length,
                     timeout_s=remaining, tenant=e.tenant,
-                    priority=e.priority)
+                    priority=e.priority, trace_id=e.trace_id,
+                    failovers=e.failovers + (1 if redispatch else 0))
             except RuntimeError:       # replica died under us: next one
                 target.alive = False
                 continue
-            redispatch = e.cur_guid is not None
             e.cur_guid = rg[0]
             e.replica = target
             e.retry_pending = False
             if redispatch:
                 e.failovers += 1
                 self._failovers_total += 1
+                if self.telemetry is not None:
+                    # recorded on the SURVIVOR: the dead replica's ring
+                    # is (being) dumped as the incident report
+                    self.telemetry.for_replica(target.id).note_failover(
+                        e.guid, prev_id, target.id, trace_id=e.trace_id)
             if e.cancel_requested:
                 target.handle.rm.cancel(e.cur_guid)
             return True
@@ -458,7 +490,8 @@ class ReplicaPool:
                                     guid=e.guid,
                                     input_tokens=list(e.prompt),
                                     output_tokens=[], status="cancelled",
-                                    cancelled=True, tenant=e.tenant), now)
+                                    cancelled=True, tenant=e.tenant,
+                                    trace_id=e.trace_id), now)
                             else:
                                 self._redispatch(e, None, None, now)
                             continue
@@ -499,6 +532,7 @@ class ReplicaPool:
                 "t_detect": now, "replica": rep.id,
                 "waiting": {e.guid for e in mine},
                 "n_requests": len(mine), "recovery_s": None})
+        self._dump_incident(rep, now, err, n_waiting=len(mine))
         for e in mine:
             res = old.rm.results.get(e.cur_guid) if old is not None else None
             self._redispatch(e, res, err, now)
@@ -515,6 +549,32 @@ class ReplicaPool:
             t.start()
             self._respawn_threads.append(t)
 
+    def _dump_incident(self, rep: Replica, now: float, err,
+                       n_waiting: int):
+        """Write the crashed replica's flight-recorder ring as an
+        incident report (telemetry/flight_recorder.py JSONL format) —
+        the what-was-it-doing-before-it-died artifact
+        ``faultinject.run_chaos`` asserts is produced and parseable."""
+        if self.telemetry is None:
+            return
+        out_dir = self.incident_dir or self.telemetry.trace_dir
+        if not out_dir:
+            return
+        self._incident_seq += 1
+        path = os.path.join(
+            out_dir, f"incident_r{rep.id}_{self._incident_seq}.jsonl")
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            self.telemetry.for_replica(rep.id).flight.dump(path, header={
+                "replica": rep.id, "t_detect_s": round(now, 6),
+                "error": (f"{type(err).__name__}: {err}"
+                          if err is not None else ""),
+                "n_waiting": n_waiting, "crashes": rep.crashes})
+        except Exception:
+            self._dirty_shutdowns += 1
+            return
+        self.incident_reports.append(path)
+
     def _respawn_replica(self, rep: Replica):
         """Cold-start a replacement OFF the monitor thread (survivors
         keep serving while the build runs); the factory call is the
@@ -529,6 +589,10 @@ class ReplicaPool:
         with self._work:
             if self._stopping:
                 return
+            if self.telemetry is not None:
+                # same ServingTelemetry instance as the previous
+                # incarnation: counters span the replica's whole life
+                handle.rm.telemetry = self.telemetry.for_replica(rep.id)
             handle.start_server()
             rep.handle = handle
             rep.alive = True
@@ -544,13 +608,14 @@ class ReplicaPool:
             final = res if res is not None else GenerationResult(
                 guid=e.guid, input_tokens=list(e.prompt), output_tokens=[],
                 status="error", error=str(err or "replica lost"),
-                tenant=e.tenant)
+                tenant=e.tenant, trace_id=e.trace_id)
             self._finalize(e, final, now)
             return
         if e.deadline is not None and now >= e.deadline:
             self._finalize(e, GenerationResult(
                 guid=e.guid, input_tokens=list(e.prompt), output_tokens=[],
-                status="timed_out", timed_out=True, tenant=e.tenant), now)
+                status="timed_out", timed_out=True, tenant=e.tenant,
+                trace_id=e.trace_id), now)
             return
         self._try_dispatch(e, now, exclude=e.replica)
 
@@ -623,6 +688,7 @@ class ReplicaPool:
                                     if recoveries else None),
             "failover_events": events,
             "dirty_shutdowns": self._dirty_shutdowns,
+            "incident_reports": list(self.incident_reports),
             "admission": (self.admission.stats()
                           if self.admission is not None else None),
         }
@@ -635,12 +701,22 @@ class ReplicaPool:
 def failover_run(pool: ReplicaPool, spec: WorkloadSpec, rate_rps: float,
                  n_requests: int = 12, seed: int = 0,
                  crash_replica: int = 0, crash_after: int = 6,
-                 process: str = "poisson", timeout_s: float = 180.0) -> dict:
+                 process: str = "poisson", timeout_s: float = 180.0,
+                 slo_policy=None) -> dict:
     """Seeded replica-crash chaos: install a FaultInjector on one
     replica's engine, replay a schedule through the pool, and report the
     failover outcome (resolved_fraction must stay 1.0 — every scheduled
-    request resolves even though a replica died mid-run)."""
+    request resolves even though a replica died mid-run).
+
+    The report carries the SLO burn-rate alert timeline (records
+    replayed through ``telemetry.slo.replay_records`` under
+    ``slo_policy``; the injected crash's failovers are the bad events,
+    so at least one alert fires). When the pool has a FleetTelemetry
+    with a trace_dir, the observability artifacts land next to the
+    per-replica traces: ``fleet_trace.json`` (stitched Chrome trace)
+    and ``metrics.json`` (merged + per-replica snapshot)."""
     from flexflow_tpu.serve.faultinject import FaultInjector
+    from flexflow_tpu.telemetry.slo import replay_records
 
     if not pool._started:
         pool.start_server()
@@ -655,6 +731,18 @@ def failover_run(pool: ReplicaPool, spec: WorkloadSpec, rate_rps: float,
     report = summarize(records, offered_rps=rate_rps,
                        n_scheduled=len(schedule))
     stats = pool.stats()
+    slo = replay_records(records, policy=slo_policy).report()
+    artifacts = None
+    if pool.telemetry is not None and pool.telemetry.trace_dir:
+        trace_path = os.path.join(pool.telemetry.trace_dir,
+                                  "fleet_trace.json")
+        pool.telemetry.stitch_chrome_trace(trace_path)
+        metrics_path = os.path.join(pool.telemetry.trace_dir,
+                                    "metrics.json")
+        with open(metrics_path, "w") as f:
+            f.write(pool.telemetry.to_json(indent=2))
+        artifacts = {"trace": trace_path, "metrics": metrics_path,
+                     "incidents": list(pool.incident_reports)}
     return {
         "crash_replica": crash_replica,
         "crash_after_calls": crash_after,
@@ -665,6 +753,9 @@ def failover_run(pool: ReplicaPool, spec: WorkloadSpec, rate_rps: float,
         "failovers_total": report["failovers_total"],
         "cold_start_s": stats["cold_start_s"],
         "failover_recovery_s": stats["failover_recovery_s"],
+        "alerts_fired": slo["alerts_fired"],
+        "slo": slo,
+        "artifacts": artifacts,
         "pool": stats,
         "report": report,
     }
@@ -676,7 +767,7 @@ def spike_run(pool: ReplicaPool, spec: WorkloadSpec, base_rps: float,
               scale_threshold: Optional[int] = None,
               scale_consecutive: int = 2,
               check_interval_s: float = 0.02, process: str = "poisson",
-              timeout_s: float = 180.0) -> dict:
+              timeout_s: float = 180.0, slo_policy=None) -> dict:
     """Measured autoscaling loop: a base phase at ``base_rps``, then a
     spike at ``spike_multiple`` x while an autoscaler thread watches the
     pool's outstanding-request count and calls ``pool.scale_up()``
@@ -737,6 +828,12 @@ def spike_run(pool: ReplicaPool, spec: WorkloadSpec, base_rps: float,
         th.join(timeout_s)
     spike = summarize(spike_records, offered_rps=spike_rate,
                       n_scheduled=n_spike)
+    from flexflow_tpu.telemetry.slo import replay_records
+    # per-phase alert timelines: the base phase is the steady-state
+    # control (zero alerts is a bench floor), the spike phase may burn
+    slo = {"base": replay_records(base_records, policy=slo_policy).report(),
+           "spike": replay_records(spike_records,
+                                   policy=slo_policy).report()}
     slo_violation_s = sum(
         max(0.0, r.latency_s - r.deadline_s) for r in spike_records
         if r.deadline_s is not None and r.status != "rejected")
@@ -750,6 +847,7 @@ def spike_run(pool: ReplicaPool, spec: WorkloadSpec, base_rps: float,
         "scale_trigger_s": scaled["triggered_at_s"],
         "cold_start_s": scaled["cold_start_s"],
         "slo_violation_s": round(slo_violation_s, 4),
+        "slo": slo,
         "base": base,
         "spike": spike,
         "pool": pool.stats(),

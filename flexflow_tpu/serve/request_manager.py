@@ -46,7 +46,7 @@ from flexflow_tpu.serve.batch_config import (
 )
 from flexflow_tpu.serve.inference_manager import InferenceManager
 from flexflow_tpu.ops.inc_attention import commit_tree_kv
-from flexflow_tpu.telemetry import get_telemetry
+from flexflow_tpu.telemetry import get_telemetry, mint_trace_id
 
 
 @dataclasses.dataclass
@@ -89,6 +89,11 @@ class Request:
     # pool re-registers the prompt, so a replica-level Request usually
     # carries the count it was re-created with)
     failovers: int = 0
+    # fleet-wide correlation id minted at the front door
+    # (telemetry.mint_trace_id); survives failover re-registration and
+    # preemption re-queues, and joins this request's Chrome-trace spans
+    # across replica pid rows. "" = minted locally at registration.
+    trace_id: str = ""
 
     def __post_init__(self):
         if not self.tokens:
@@ -137,6 +142,8 @@ class GenerationResult:
     # times the request was re-dispatched to another replica after a
     # crash (serve/replica.py failover; re-prefilled, token-identical)
     failovers: int = 0
+    # fleet-wide correlation id (see Request.trace_id)
+    trace_id: str = ""
 
 
 class RequestManager:
@@ -189,11 +196,20 @@ class RequestManager:
                              timeout_s: Optional[float] = None,
                              deadline_s: Optional[float] = None,
                              tenant: str = "default",
-                             priority: int = 0) -> int:
+                             priority: int = 0,
+                             trace_id: Optional[str] = None,
+                             failovers: int = 0,
+                             preemptions: int = 0) -> int:
         """Register one request. ``timeout_s`` is relative to arrival;
         ``deadline_s`` is an absolute time.perf_counter() instant (wins
         when both are given). An expired request is cancelled between
-        decode rounds with its partial output (``timed_out=True``)."""
+        decode rounds with its partial output (``timed_out=True``).
+
+        ``trace_id`` is the fleet-wide correlation id; the replica pool
+        passes the one it minted at the front door (so a failed-over
+        request keeps its id across replicas — ``failovers``/
+        ``preemptions`` carry the prior-life counts the same way), and a
+        standalone manager mints its own."""
         if isinstance(prompt, str):
             assert self.tokenizer is not None, "string prompts need a tokenizer"
             toks = list(self.tokenizer.encode(prompt))
@@ -208,12 +224,16 @@ class RequestManager:
                       max_new_tokens=max_new_tokens,
                       max_sequence_length=max_sequence_length,
                       arrival_s=arrival, tenant=tenant, priority=priority,
-                      deadline_s=deadline_s or 0.0)
+                      deadline_s=deadline_s or 0.0,
+                      trace_id=trace_id or mint_trace_id(),
+                      failovers=int(failovers),
+                      preemptions=int(preemptions))
         self.pending.append(req)
         self.inflight[guid] = req
         tel = self._tel()
         if tel is not None:
-            tel.note_admission(guid, len(toks), max_new_tokens)
+            tel.note_admission(guid, len(toks), max_new_tokens,
+                               trace_id=req.trace_id)
         return guid
 
     def cancel(self, guid: int) -> bool:
@@ -284,13 +304,15 @@ class RequestManager:
             status=req.status, timed_out=req.status == "timed_out",
             cancelled=req.status == "cancelled", error=req.error,
             tenant=req.tenant, preemptions=req.preemptions,
-            failovers=req.failovers)
+            failovers=req.failovers, trace_id=req.trace_id)
         self.inflight.pop(req.guid, None)
         tel = self._tel()
         if tel is not None:
             tel.note_finish(req.guid, len(out), res.latency_s, res.ttft_s,
                             queue_wait_s=res.queue_wait_s,
-                            prefill_s=res.prefill_s, status=req.status)
+                            prefill_s=res.prefill_s, status=req.status,
+                            failovers=req.failovers,
+                            preemptions=req.preemptions)
         if self.tokenizer is not None:
             try:
                 res.input_text = self.tokenizer.decode(res.input_tokens)
@@ -330,6 +352,9 @@ class RequestManager:
         req.slot = slot
         req.prefill_start_s = time.perf_counter()
         active[slot] = req
+        tel = self._tel()
+        if tel is not None:
+            tel.note_slot_grant(req.guid, slot)
         return True
 
     def _fill_slots(self, active: List[Optional[Request]], max_seq: int,

@@ -48,7 +48,17 @@ ffsv_spec_effective_depth        histogram  controller depth per spec round
 ffsv_spec_fallback_total         counter    requests parked on incremental
 ffsv_spec_fallback_active        gauge      requests currently parked
 ffsv_spec_acceptance_ewma        gauge      mean controller acceptance EWMA
+ffsv_jit_cache_misses_total      counter    engine block compiles (traces)
+ffsv_engine_retraces_total       counter    compiles BEYOND each engine's 1st
+ffsv_failovers_total             counter    crash re-dispatches to survivors
 ===============================  =========  =================================
+
+Fleet layer (this package's distributed half): ``fleet.FleetTelemetry``
+keeps one ServingTelemetry per replica (distinct Chrome-trace ``pid``
+rows, merged registries via ``MetricsRegistry.merge``), ``slo`` holds
+the error-budget burn-rate alerting the load harnesses report, and
+``flight_recorder`` is the bounded per-replica event ring the
+ReplicaPool dumps as a JSONL incident report on crash detection.
 
 The request-level SLO histograms (latency/ttft/queue-wait/prefill/
 per-token) carry a sliding window (``slo_window_s``, default 60 s):
@@ -83,7 +93,12 @@ from flexflow_tpu.telemetry.metrics import (
     MetricsRegistry,
     percentile,
 )
-from flexflow_tpu.telemetry.tracing import SpanTracer, load_jsonl
+from flexflow_tpu.telemetry.flight_recorder import (FlightRecorder,
+                                                    load_incident_report)
+from flexflow_tpu.telemetry.slo import SLOMonitor, SLOPolicy, replay_records
+from flexflow_tpu.telemetry.tracing import (SpanTracer, load_jsonl,
+                                            mint_trace_id,
+                                            stitch_chrome_trace)
 
 
 class ServingTelemetry:
@@ -94,11 +109,20 @@ class ServingTelemetry:
     spelled, so the table in the module docstring stays the schema."""
 
     SLO_WINDOW_S = 60.0
+    FLIGHT_CAPACITY = 512
 
     def __init__(self, trace_path: Optional[str] = None,
-                 slo_window_s: Optional[float] = None):
+                 slo_window_s: Optional[float] = None,
+                 pid: int = 1, process_name: Optional[str] = None,
+                 flight_capacity: Optional[int] = None):
         self.registry = MetricsRegistry()
-        self.tracer = SpanTracer(trace_path)
+        self.tracer = SpanTracer(trace_path, pid=pid,
+                                 process_name=process_name)
+        # crash-forensics ring: hooks below append; the ReplicaPool
+        # monitor dumps it as an incident report on crash detection
+        self.flight = FlightRecorder(
+            self.FLIGHT_CAPACITY if flight_capacity is None
+            else flight_capacity)
         win = self.SLO_WINDOW_S if slo_window_s is None else slo_window_s
         r = self.registry
         self.requests_total = r.counter(
@@ -190,12 +214,31 @@ class ServingTelemetry:
         self.spec_acceptance_ewma = r.gauge(
             "ffsv_spec_acceptance_ewma",
             "mean per-token acceptance EWMA over live spec requests")
+        # compile observability (serve/engine.py): the engines count
+        # their own _block_impl traces (the python body only executes
+        # while XLA traces), so these count COMPILES exactly — the PR 15
+        # "adaptive mixed batches never retrace" invariant as a metric
+        self.jit_cache_misses = r.counter(
+            "ffsv_jit_cache_misses_total",
+            "fused engine block compiles (jit cache misses)")
+        self.engine_retraces = r.counter(
+            "ffsv_engine_retraces_total",
+            "engine block compiles beyond each engine's expected first")
+        self.failovers = r.counter(
+            "ffsv_failovers_total",
+            "crash re-dispatches of in-flight/queued requests to "
+            "surviving replicas (serve/replica.py)")
 
     # -- hooks (serve/request_manager.py, serve/engine.py) ---------------
     def note_admission(self, guid: int, prompt_tokens: int,
-                       max_new_tokens: int):
+                       max_new_tokens: int,
+                       trace_id: Optional[str] = None):
         self.requests_total.inc()
-        self.tracer.admission(guid, prompt_tokens, max_new_tokens)
+        self.tracer.admission(guid, prompt_tokens, max_new_tokens,
+                              trace_id=trace_id)
+        self.flight.record("admission", guid=guid, trace_id=trace_id,
+                           prompt_tokens=prompt_tokens,
+                           max_new_tokens=max_new_tokens)
 
     def note_batch(self, pending: int, live: int, slots: int,
                    kv_fraction: Optional[float]):
@@ -205,17 +248,57 @@ class ServingTelemetry:
         self.batch_occupancy.observe(live / max(1, slots))
         if kv_fraction is not None:
             self.kv_utilization.observe(kv_fraction)
+        self.flight.record("batch", pending=pending, live=live,
+                           slots=slots,
+                           kv_fraction=(round(kv_fraction, 4)
+                                        if kv_fraction is not None
+                                        else None))
 
     def note_rejected(self, tenant: str, reason: str, queue_depth: int):
         """One admission rejection at the front door (serve/api.py's
         submit path, before any request is registered)."""
         self.requests_rejected.inc()
         self.submit_queue_depth.set(queue_depth)
+        self.flight.record("rejection", tenant=tenant, reason=reason,
+                           queue_depth=queue_depth)
 
     def note_preempted(self, guid: int):
         """One slot eviction: a running best-effort request re-queued so
         a deadline-at-risk higher-priority one takes its slot."""
         self.requests_preempted.inc()
+        self.flight.record("preemption", guid=guid)
+
+    def note_slot_grant(self, guid: int, slot: int):
+        """One batch-slot grant (request_manager._grant): the queue-wait
+        -> service boundary, recorded for crash forensics — "what was
+        scheduled right before the crash" is the first question an
+        incident report answers."""
+        self.flight.record("slot_grant", guid=guid, slot=slot)
+
+    def note_retrace(self, engine: str, new_traces: int,
+                     total_traces: int):
+        """Compile-count accounting after an engine block call that
+        traced: ``new_traces`` compiles happened during the call,
+        bringing the engine's lifetime count to ``total_traces``. Every
+        trace is a jit cache miss; anything beyond the engine's expected
+        single compile is a retrace (the PR 15 no-retrace invariant
+        violation, also flight-recorded — a retrace storm right before a
+        crash is a classic incident signature)."""
+        self.jit_cache_misses.inc(new_traces)
+        retraces = min(int(new_traces), max(0, int(total_traces) - 1))
+        if retraces > 0:
+            self.engine_retraces.inc(retraces)
+            self.flight.record("retrace", engine=engine,
+                               traces=int(total_traces))
+
+    def note_failover(self, guid: int, replica: int, target: int,
+                      trace_id: Optional[str] = None):
+        """One crash re-dispatch (serve/replica.py): the request keeps
+        its trace_id; only the serving replica (and per-replica guid)
+        changes."""
+        self.failovers.inc()
+        self.flight.record("failover", guid=guid, replica=replica,
+                           target=target, trace_id=trace_id)
 
     def record_prefill(self, seconds: float, n_tokens: int, rows=()):
         self.prefill_seconds.observe(seconds)
@@ -231,6 +314,8 @@ class ServingTelemetry:
         t0 = time.perf_counter() - seconds
         for g in guids:
             self.tracer.decode_block(g, steps, t0, seconds)
+        self.flight.record("decode_block", seconds=round(seconds, 6),
+                           steps=int(steps), n_live=int(n_live))
 
     def record_spec_block(self, seconds: float, n_acc: np.ndarray,
                           depth: int, tree_width: int, depths=None):
@@ -249,9 +334,18 @@ class ServingTelemetry:
         self.spec_rounds.inc(int(valid.size))
         self.acceptance_length.observe_many(valid.tolist())
         self.tokens_per_round.observe_many((valid + 1).tolist())
+        dv = None
         if depths is not None:
             dv = np.asarray(depths).ravel()[mask]
             self.spec_effective_depth.observe_many(dv[dv > 0].tolist())
+        # flight-recorder round summary + depth decision, one event per
+        # fused block (same granularity as every other hook)
+        self.flight.record(
+            "spec_block", seconds=round(seconds, 6),
+            rounds=int(valid.size), committed=int((valid + 1).sum()),
+            mean_acc=(round(float(valid.mean()), 3) if valid.size else 0.0),
+            depths=(sorted(set(int(d) for d in dv[dv > 0]))
+                    if dv is not None else [int(depth)]))
 
     def note_spec_controller(self, ewma_mean, n_fallback: int,
                              new_fallbacks: int):
@@ -275,7 +369,8 @@ class ServingTelemetry:
 
     def note_finish(self, guid: int, output_tokens: int, latency_s: float,
                     ttft_s: float, queue_wait_s: float = 0.0,
-                    prefill_s: float = 0.0, status: str = "ok"):
+                    prefill_s: float = 0.0, status: str = "ok",
+                    failovers: int = 0, preemptions: int = 0):
         self.requests_finished.inc()
         if status == "timed_out":
             self.requests_timed_out.inc()
@@ -292,7 +387,12 @@ class ServingTelemetry:
             self.request_queue_wait.observe(queue_wait_s)
         if prefill_s > 0:
             self.request_prefill.observe(prefill_s)
-        self.tracer.finish(guid, output_tokens, latency_s, ttft_s)
+        self.tracer.finish(guid, output_tokens, latency_s, ttft_s,
+                           status=status, failovers=failovers,
+                           preemptions=preemptions)
+        self.flight.record("finish", guid=guid, status=status,
+                           output_tokens=int(output_tokens),
+                           latency_s=round(latency_s, 6))
 
     def close(self):
         self.tracer.close()
@@ -326,6 +426,40 @@ def get_telemetry() -> Optional[ServingTelemetry]:
     return _telemetry
 
 
+_fleets = None      # weak set of live FleetTelemetry instances
+
+
+def _fleet_set():
+    global _fleets
+    if _fleets is None:
+        import weakref
+
+        _fleets = weakref.WeakSet()
+    return _fleets
+
+
+def register_fleet(fleet):
+    """FleetTelemetry self-registers so process-wide aggregation
+    (``aggregate_registry`` -> ``ffsv_metrics_dump``) sees every live
+    replica pool. Weakly held: a collected pool drops out on its own."""
+    _fleet_set().add(fleet)
+
+
+def aggregate_registry() -> MetricsRegistry:
+    """Process-wide fleet totals: the global registry (single-engine
+    traffic) merged with every live fleet's per-replica registries —
+    what a C host reads through the aggregated ``ffsv_metrics_dump``.
+    Exact by construction (MetricsRegistry.merge); an empty process
+    yields an empty registry."""
+    regs = []
+    tel = get_telemetry()
+    if tel is not None:
+        regs.append(tel.registry)
+    for fleet in list(_fleet_set()):
+        regs.extend(t.registry for t in fleet.replica_telemetries())
+    return MetricsRegistry.merge(regs)
+
+
 def ensure_telemetry(trace_path: Optional[str] = None) -> ServingTelemetry:
     """Enable the global telemetry if absent, otherwise keep the live
     instance (its registry survives) and attach ``trace_path`` to its
@@ -349,16 +483,25 @@ __all__ = [
     "DEFAULT_TIME_BUCKETS",
     "FRACTION_BUCKETS",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsHTTPServer",
     "MetricsRegistry",
+    "SLOMonitor",
+    "SLOPolicy",
     "ServingTelemetry",
     "SpanTracer",
+    "aggregate_registry",
     "disable_telemetry",
     "enable_telemetry",
     "ensure_telemetry",
     "get_telemetry",
+    "load_incident_report",
     "load_jsonl",
+    "mint_trace_id",
     "percentile",
+    "register_fleet",
+    "replay_records",
+    "stitch_chrome_trace",
 ]

@@ -282,6 +282,67 @@ class MetricsRegistry:
     def get(self, name: str):
         return self._metrics.get(name)
 
+    @classmethod
+    def merge(cls, registries: Sequence["MetricsRegistry"]
+              ) -> "MetricsRegistry":
+        """EXACT fleet aggregation: a new registry whose every instrument
+        equals what one registry would hold had all inputs' observations
+        landed on it (the pool-level ``/metrics`` + aggregated
+        ``ffsv_metrics_dump`` contract, asserted instrument-by-instrument
+        in tests/test_observability.py).
+
+        * counters: values sum.
+        * gauges: values sum — the fleet gauges here are extensive
+          (queue depths, parked-request counts); a fleet-wide "current
+          depth" IS the per-replica sum. Intensive gauges (EWMA means)
+          lose their mean-of-means subtlety, documented in README.
+        * histograms: bucket counts add elementwise, sums/counts add,
+          retained samples concatenate (re-capped at sample_cap), and
+          sliding windows merge by timestamp so windowed percentiles
+          over the merged registry equal percentiles over the union of
+          in-window samples. Same-name histograms must share bucket
+          layout and window_s (one vocabulary — ServingTelemetry — so a
+          mismatch means two incompatible schema versions: raise).
+        """
+        out = cls()
+        for reg in registries:
+            for name, m in reg._metrics.items():
+                if isinstance(m, Counter):
+                    out.counter(name, m.help).inc(m.value)
+                elif isinstance(m, Gauge):
+                    out.gauge(name, m.help).inc(m.value)
+                elif isinstance(m, Histogram):
+                    t = out._get_or_create(Histogram, name, m.help,
+                                           buckets=m.buckets,
+                                           window_s=m.window_s)
+                    if t.buckets != m.buckets:
+                        raise ValueError(
+                            f"histogram {name!r}: bucket layouts differ "
+                            f"across replicas ({t.buckets} vs {m.buckets})")
+                    if t.window_s != m.window_s:
+                        raise ValueError(
+                            f"histogram {name!r}: window_s differs across "
+                            f"replicas ({t.window_s} vs {m.window_s})")
+                    for i, c in enumerate(m._counts):
+                        t._counts[i] += c
+                    t._sum += m._sum
+                    t._n += m._n
+                    t._samples.extend(m._samples)
+                    if len(t._samples) > t._cap:
+                        # keep the most RECENT samples, like the ring
+                        t._samples = t._samples[-t._cap:]
+                        t._next = 0
+                    if t._win is not None and m._win:
+                        t._win.extend(m._win)
+                else:           # pragma: no cover — closed instrument set
+                    raise TypeError(f"unmergeable metric {name!r}: "
+                                    f"{type(m).__name__}")
+        # merged windows must be time-ordered for writer-side eviction
+        for m in out._metrics.values():
+            if isinstance(m, Histogram) and m._win:
+                m._win = deque(sorted(m._win))
+        return out
+
     def reset(self):
         """Zero every instrument IN PLACE (for callers separating timed
         passes). Instruments stay registered, so cached references —

@@ -4,7 +4,10 @@ Every serving request emits a span sequence — admission -> prefill
 chunk(s) -> decode/speculation rounds -> finish — as Chrome Trace Event
 Format objects, one JSON object per line (JSONL). Each event carries the
 request guid as its ``tid``, so Perfetto renders one track per request;
-``pid`` 1 is the serving process. ``export_chrome_trace`` wraps the
+``pid`` identifies the serving process (1 for a single engine; replica
+pools assign one pid per replica and ``stitch_chrome_trace`` merges the
+per-replica tracers onto one clock-corrected timeline, correlated by the
+fleet-wide ``args.trace_id``). ``export_chrome_trace`` wraps the
 buffered events into a ``{"traceEvents": [...]}`` file that Perfetto /
 chrome://tracing load directly (the raw JSONL is for programmatic
 consumption: one ``json.loads`` per line).
@@ -29,9 +32,25 @@ exact, per-round timestamps are block-granular estimates.
 
 from __future__ import annotations
 
+import itertools
 import json
 import time
-from typing import IO, List, Optional
+from typing import IO, Iterable, List, Optional
+
+# Process-wide trace-id mint (serve/api.py front door + serve/replica.py
+# pool). A counter, not a UUID: runs replay deterministically, and the
+# id only needs to be unique within one serving process/trace file. The
+# hex digits keep grep-ability ("t-0000002a") without dragging in
+# entropy the tests would have to mock out.
+_trace_counter = itertools.count(1)
+
+
+def mint_trace_id() -> str:
+    """New distributed-trace id. Minted ONCE per request at the front
+    door (submit/pool dispatch) and carried unchanged across failover
+    re-dispatch, preemption re-queue, and the native shadow path — the
+    correlation key that stitches a request's spans across replicas."""
+    return f"t-{next(_trace_counter):08x}"
 
 
 class SpanTracer:
@@ -46,12 +65,19 @@ class SpanTracer:
 
     FLUSH_EVERY = 128
 
-    def __init__(self, path: Optional[str] = None, max_events: int = 65536):
+    def __init__(self, path: Optional[str] = None, max_events: int = 65536,
+                 pid: int = 1, process_name: Optional[str] = None):
         from collections import deque
 
         self.path = path
+        self.pid = int(pid)
         self._ring = deque(maxlen=max(1, int(max_events)))
         self._sync: Optional[dict] = None
+        self._name_ev: Optional[dict] = None
+        # guid -> trace_id, registered at admission and stamped into
+        # every subsequent span's args (popped at finish). Distinct from
+        # tid=guid: the guid is per-replica, the trace_id is fleet-wide.
+        self._ids = {}
         self._file: Optional[IO[str]] = None
         self._n_written = 0
         self._t0 = time.perf_counter()
@@ -59,11 +85,21 @@ class SpanTracer:
             self._file = open(path, "w")
         self.emit("clock_sync", "M", ts_s=self._t0,
                   wall_time_s=time.time(), perf_counter_origin=self._t0)
+        if process_name:
+            # Chrome-trace process_name metadata: Perfetto labels this
+            # pid's row group (one group per replica in a stitched trace)
+            ev = {"name": "process_name", "ph": "M", "pid": self.pid,
+                  "tid": 0, "ts": 0.0, "args": {"name": process_name}}
+            self._name_ev = ev
+            if self._file is not None:
+                self._file.write(json.dumps(ev) + "\n")
+                self._n_written += 1
 
     @property
     def events(self) -> List[dict]:
-        """clock_sync + the retained (most recent) event window."""
-        return ([self._sync] if self._sync else []) + list(self._ring)
+        """clock_sync (+ process_name) + the retained event window."""
+        head = [e for e in (self._sync, self._name_ev) if e]
+        return head + list(self._ring)
 
     def attach_file(self, path: str) -> bool:
         """Start writing JSONL to ``path`` on an already-live tracer,
@@ -88,14 +124,19 @@ class SpanTracer:
              **args):
         """Record one Trace Event Format object. ``ph``: "X" complete
         span (needs dur_s), "i" instant, "M" metadata. ``ts_s``/``dur_s``
-        are perf_counter-based seconds; ts defaults to now."""
-        ev = {"name": name, "ph": ph, "pid": 1,
+        are perf_counter-based seconds; ts defaults to now. A trace_id
+        registered for ``guid`` (via admission) is stamped into args."""
+        ev = {"name": name, "ph": ph, "pid": self.pid,
               "tid": int(guid) if guid is not None else 0,
               "ts": round(self._us(ts_s), 1)}
         if dur_s is not None:
             ev["dur"] = round(dur_s * 1e6, 1)
         if ph == "i":
             ev["s"] = "t"            # thread-scoped instant
+        if guid is not None and "trace_id" not in args:
+            tid = self._ids.get(int(guid))
+            if tid is not None:
+                args["trace_id"] = tid
         if args:
             ev["args"] = args
         if ev["name"] == "clock_sync":
@@ -112,7 +153,10 @@ class SpanTracer:
                 self._file.flush()
 
     # -- span vocabulary (the JSONL schema documented in README) ----------
-    def admission(self, guid: int, prompt_tokens: int, max_new_tokens: int):
+    def admission(self, guid: int, prompt_tokens: int, max_new_tokens: int,
+                  trace_id: Optional[str] = None):
+        if trace_id:
+            self._ids[int(guid)] = trace_id
         self.emit("admission", "i", guid, request_guid=guid,
                   prompt_tokens=prompt_tokens,
                   max_new_tokens=max_new_tokens)
@@ -141,11 +185,19 @@ class SpanTracer:
                   committed_tokens=committed)
 
     def finish(self, guid: int, output_tokens: int, latency_s: float,
-               ttft_s: float):
+               ttft_s: float, status: str = "ok", failovers: int = 0,
+               preemptions: int = 0):
+        """Terminal span: carries the closed status taxonomy
+        (ok|timed_out|cancelled|error) plus the disruption counts, so a
+        trace query can partition requests by disposition without
+        joining against the metrics registry."""
         self.emit("finish", "i", guid, request_guid=guid,
                   output_tokens=output_tokens,
                   latency_s=round(latency_s, 6),
-                  ttft_s=round(ttft_s, 6))
+                  ttft_s=round(ttft_s, 6),
+                  status=status, failovers=int(failovers),
+                  preemptions=int(preemptions))
+        self._ids.pop(int(guid), None)
 
     # -- output -----------------------------------------------------------
     def export_chrome_trace(self, path: str):
@@ -168,3 +220,39 @@ def load_jsonl(path: str) -> List[dict]:
     """Parse a JSONL trace back into event dicts (test/analysis helper)."""
     with open(path) as f:
         return [json.loads(line) for line in f if line.strip()]
+
+
+def stitch_chrome_trace(tracers: Iterable["SpanTracer"],
+                        path: Optional[str] = None) -> List[dict]:
+    """Merge several tracers' buffered events into ONE Chrome trace on a
+    common timeline (the fleet view: one pid row group per replica).
+
+    Every tracer timestamps relative to its own ``perf_counter`` origin
+    (its ``clock_sync`` record), so naive concatenation would overlay
+    replicas spawned minutes apart at t=0. Correction: the EARLIEST
+    origin becomes the fleet epoch and each tracer's events shift by
+    ``(origin_i - origin_base) * 1e6`` µs — all tracers live in one
+    process, so perf_counter deltas ARE the true skew (for cross-host
+    stitching the clock_sync wall_time_s field would anchor instead).
+    Per-tracer pids keep replica rows separate; a failed-over request's
+    spans appear under BOTH pids sharing one ``args.trace_id``.
+
+    Returns the merged event list; writes ``{"traceEvents": ...}`` JSON
+    when ``path`` is given."""
+    tracers = list(tracers)
+    if not tracers:
+        merged: List[dict] = []
+    else:
+        base = min(tr._t0 for tr in tracers)
+        merged = []
+        for tr in tracers:
+            shift_us = (tr._t0 - base) * 1e6
+            for ev in tr.events:
+                ev = dict(ev)
+                if ev.get("ph") != "M":
+                    ev["ts"] = round(ev.get("ts", 0.0) + shift_us, 1)
+                merged.append(ev)
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump({"traceEvents": merged, "displayTimeUnit": "ms"}, f)
+    return merged

@@ -300,8 +300,18 @@ char *ffsv_get_output_text(void *llm, long guid);
  * "prometheus" (text exposition). Enable by setting the config field
  * "telemetry" to "true" before ffsv_llm_create (optionally
  * "telemetry_trace_path" for the JSONL span trace); disabled telemetry
- * dumps an empty snapshot ("{}" / ""). Returns a malloc'd string the
- * caller frees, or NULL on error (see ffsv_last_error). */
+ * dumps an empty snapshot ("{}" / "").
+ *
+ * When the process also runs a replica fleet (FleetTelemetry /
+ * ReplicaPool on the Python side), the dump is the AGGREGATE across the
+ * global registry plus every live per-replica registry — counters sum,
+ * histograms merge bucket-exactly — so one call sees the whole fleet.
+ * Per-replica breakdowns (replica="N" labels in prometheus, a
+ * "replicas" map in json) are available via FleetTelemetry.snapshot /
+ * to_prometheus in-process; the C surface exposes the pooled view.
+ * Unknown format strings fail (NULL + ffsv_last_error) rather than
+ * guessing. Returns a malloc'd string the caller frees, or NULL on
+ * error (see ffsv_last_error). */
 char *ffsv_metrics_dump(const char *format);
 
 #ifdef __cplusplus

@@ -311,7 +311,10 @@ char *ffsv_get_output_text(void *llm, long guid) {
 /* Snapshot the serving telemetry registry ("json" or "prometheus");
  * malloc'd string the caller frees, or NULL on error. Empty snapshot
  * ("{}" / "") when telemetry is disabled — enable via
- * ffsv_config_set(cfg, "telemetry", "true") before ffsv_llm_create. */
+ * ffsv_config_set(cfg, "telemetry", "true") before ffsv_llm_create.
+ * With a replica fleet live in-process the dump aggregates the global
+ * registry plus every replica registry (counters sum, histograms merge
+ * bucket-exactly) — see flexflow_tpu_c.h for the full contract. */
 char *ffsv_metrics_dump(const char *format) {
   PyObject *r = call("metrics_dump",
                      Py_BuildValue("(s)", format ? format : "json"));

@@ -79,6 +79,12 @@ HEADLINES: Dict[str, float] = {
 # quadratic), not scheduler jitter.
 LOWER_IS_BETTER: Dict[str, float] = {
     "serving_fleet.cold_start_s": 0.60,
+    # observability tax (ISSUE 18): fraction of tiny-pair throughput lost
+    # to live telemetry; bench floors it at 0.02 so the MIN prior can't
+    # collapse to ~0 and arm a hair-trigger — the gate then fires when a
+    # round doubles the best prior tax (e.g. an unguarded hook landing on
+    # the decode hot path).
+    "telemetry_overhead.overhead_frac": 1.00,
 }
 
 # Absolute floors, enforced on the LATEST round only when its bench line
@@ -101,9 +107,14 @@ FLOOR_GROUPS: Dict[str, Dict[str, float]] = {
         "serving_overload.resolved_fraction": 1.0,
     },
     # ISSUE 17: under seeded replica-crash chaos every submitted future
-    # must still resolve (failover re-dispatch, token-identical)
+    # must still resolve (failover re-dispatch, token-identical).
+    # ISSUE 18 alert sanity: the injected crash must fire >= 1 burn-rate
+    # alert, and the steady-state control phase must fire none
+    # (alerts_steady_ok is the run's 0/1 encoding of the latter).
     "serving_fleet": {
         "serving_fleet.resolved_fraction": 1.0,
+        "serving_fleet.alerts_fired_overload": 1.0,
+        "serving_fleet.alerts_steady_ok": 1.0,
     },
 }
 

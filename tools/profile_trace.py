@@ -21,6 +21,30 @@ sys.path.insert(0, ".")
 sys.path.insert(0, "tools")
 
 
+def emit_clock_sync(telemetry, path):
+    """Write the replica-pool tracers' ``clock_sync`` records (one per
+    replica pid) as JSONL, so a ``jax.profiler`` device trace captured
+    around a pool run can be aligned with the fleet span trace: each
+    record carries the tracer's wall-clock epoch plus the perf_counter
+    origin its span timestamps are relative to (the recipe in the README
+    "Telemetry" section, extended to one record per replica thread).
+
+    ``telemetry`` is a FleetTelemetry (or anything with
+    ``replica_telemetries()``) or an iterable of SpanTracers."""
+    import json
+
+    if hasattr(telemetry, "replica_telemetries"):
+        tracers = [t.tracer for t in telemetry.replica_telemetries()]
+    else:
+        tracers = list(telemetry)
+    with open(path, "w") as f:
+        for tr in tracers:
+            sync = dict(tr._sync or {})
+            sync["pid"] = tr.pid
+            f.write(json.dumps(sync) + "\n")
+    return path
+
+
 def aggregate(trace_dir, steps=3, min_pct=0.5):
     """Aggregate the device plane's "XLA Ops" line: per-op kind totals
     (fusion-name prefixes) + top individual ops, per step."""
