@@ -600,6 +600,117 @@ def telemetry_overhead_section() -> dict:
     }
 
 
+def serving_prefix_section() -> dict:
+    """Prefix-caching saturation line (ISSUE 19): the same seeded
+    shared-prefix workload (2 tenant "system prompts" x short per-request
+    suffixes, serve/loadgen.py's shared_prefix mix) swept to its knee
+    twice on a dedicated tiny incremental engine — prefix cache ON vs
+    OFF. With the cache on, every request after a group's first skips the
+    system prompt's prefill FLOPs (KV installed from the refcounted radix
+    pool, serve/prefix_cache.py), so the knee must sit RIGHT of the
+    no-reuse knee and prefilled-tokens-per-request must drop; both are
+    gated by tools/bench_trend.py (knee_ratio / prefix_saved_frac
+    absolute floors keyed on this section's presence). Dedicated tiny
+    geometry like the fleet/telemetry sections: the section measures
+    scheduling + reuse accounting, not chip speed — the workload is
+    prefill-dominated (long prefix, tiny suffix + output) so the saved
+    FLOPs are visible above the per-round dispatch overhead."""
+    import flexflow_tpu as ff
+    from flexflow_tpu.ffconst import InferenceMode
+    from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+    from flexflow_tpu.serve.batch_config import GenerationConfig
+    from flexflow_tpu.serve.loadgen import (EngineHandle, LoadRunner,
+                                            TenantSpec, WorkloadSpec,
+                                            build_schedule, find_knee,
+                                            summarize)
+    from flexflow_tpu.serve.request_manager import RequestManager
+
+    tiny = LLAMAConfig(vocab_size=128, hidden_size=64,
+                       intermediate_size=128, num_hidden_layers=2,
+                       num_attention_heads=4, num_key_value_heads=2,
+                       max_position_embeddings=256)
+    cfg = ff.FFConfig(max_requests_per_batch=4, max_sequence_length=160,
+                      max_tokens_per_batch=16, seed=0,
+                      kv_cache_dtype="float32")
+    llm = ff.FFModel(cfg)
+    create_llama_model(llm, tiny, mode=InferenceMode.INC_DECODING_MODE)
+    llm.compile(comp_mode=ff.CompMode.COMP_MODE_INFERENCE)
+
+    spec = WorkloadSpec(
+        prompt_lens=(4, 8), output_lens=(2, 4), vocab_size=128,
+        shared_prefix_groups=2, shared_prefix_len=96,
+        tenants=(TenantSpec("default", 1.0),))
+
+    def batch_pass(on: bool):
+        """Back-to-back pass: warms the jit caches for one config AND
+        (second call) measures the engine's no-queueing throughput — the
+        rate the sweep steps are scaled off."""
+        rm = RequestManager()
+        for r in build_schedule(spec, 6, 100.0, seed=3):
+            rm.register_new_request(r.prompt,
+                                    max_new_tokens=r.max_new_tokens)
+        t0 = time.perf_counter()
+        rm.generate_incr_decoding(
+            llm, generation_config=GenerationConfig(prefix_cache=on))
+        return 6.0 / (time.perf_counter() - t0)
+
+    batch_pass(False)              # compile warmup, both paths
+    batch_pass(True)
+    base_rps = batch_pass(False)   # cache-OFF sustainable req/s
+
+    def one_sweep(on: bool):
+        # hand-rolled rate loop instead of loadgen.sweep(): uniform
+        # arrivals consume no rng draws, so ONE seed gives every step the
+        # same prompts/prefixes — the pool stays hot across steps and
+        # reuse survives a burst arriving before any insert lands (sweep
+        # reseeds per step, which would cold-start every rate)
+        handle = EngineHandle(
+            llm, generation_config=GenerationConfig(prefix_cache=on))
+        runner = LoadRunner(handle)
+        steps = []
+        try:
+            for mult in (0.5, 1.0, 2.0, 4.0):
+                rate = mult * base_rps
+                sched = build_schedule(spec, 10, rate, seed=7,
+                                       process="uniform")
+                recs = runner.run(sched, timeout_s=300.0)
+                steps.append(summarize(recs, offered_rps=rate))
+        finally:
+            handle.stop_server()
+        return {"steps": steps, "knee_rps": find_knee(steps)}
+
+    off = one_sweep(False)
+    on = one_sweep(True)
+    # a sweep where even the lowest step failed scores half that step's
+    # rate, so a broken cache path FAILS the knee_ratio floor loudly
+    # instead of dividing by None
+    floor_rps = 0.25 * base_rps
+    knee_off = off["knee_rps"] or floor_rps
+    knee_on = on["knee_rps"] or floor_rps
+    # reuse accounting from the lowest (uncongested) step of each sweep
+    pf_off = off["steps"][0]["prefill_tokens_per_request"]
+    pf_on = on["steps"][0]["prefill_tokens_per_request"]
+    slim = lambda s: {k: s[k] for k in (
+        "offered_rps", "achieved_rps", "ttft_p99_s", "latency_p99_s",
+        "prefill_tokens_per_request", "prefix_hit_tokens_total")}
+    return {
+        "workload": {"groups": 2, "prefix_len": 96, "suffix_lens": [4, 8],
+                     "output_lens": [2, 4], "n_per_step": 10},
+        "base_rps": round(base_rps, 3),
+        "knee_rps_off": round(knee_off, 3),
+        "knee_rps_on": round(knee_on, 3),
+        # the tentpole headline: how far right did reuse move the knee
+        "knee_ratio": round(knee_on / knee_off, 3),
+        "prefill_tokens_per_req_off": pf_off,
+        "prefill_tokens_per_req_on": pf_on,
+        "prefix_saved_frac": round(1.0 - pf_on / max(pf_off, 1e-9), 4),
+        "prefix_hit_tokens_total": sum(
+            s["prefix_hit_tokens_total"] for s in on["steps"]),
+        "steps_off": [slim(s) for s in off["steps"]],
+        "steps_on": [slim(s) for s in on["steps"]],
+    }
+
+
 def _bf16_companion_line():
     """Run the bf16 1.3B-class geometry in a CHILD process and fold its
     headline into this run's JSON line (VERDICT r3 item 7: report a bf16
@@ -838,6 +949,18 @@ def main():
         except Exception as e:
             telemetry_overhead = {"error": str(e)[:200]}
 
+    # prefix-caching knee shift (ISSUE 19): shared-prefix workload swept
+    # cache-on vs cache-off on a dedicated tiny engine — bench_trend
+    # floors knee_ratio and prefix_saved_frac when the section is
+    # present. Same never-lose-the-headline contract.
+    serving_prefix = {}
+    if "--no-load" not in sys.argv and "--no-fleet" not in sys.argv:
+        try:
+            serving_prefix = with_retry(
+                lambda: serving_prefix_section(), "serving prefix run")
+        except Exception as e:
+            serving_prefix = {"error": str(e)[:200]}
+
     # --- acceptance-realism sweep (VERDICT r4 weak-5/item 7): the
     # headline's tokens/round comes from ONE damping point (EPS); vary
     # the draft-verifier divergence by re-scaling the verifier's deep
@@ -950,6 +1073,10 @@ def main():
         # live ServingTelemetry (registry + tracer + flight ring) vs off
         **({"telemetry_overhead": telemetry_overhead}
            if telemetry_overhead else {}),
+        # prefix-caching knee shift: knee_ratio (reuse vs no-reuse) and
+        # prefilled-tokens-per-request drop on the shared-prefix mix —
+        # absolute-floored by bench_trend when present
+        **({"serving_prefix": serving_prefix} if serving_prefix else {}),
         # trace-time dispatch counts: how many attention ops COMPILED onto
         # each path (fused loops trace once however many steps execute)
         "attention_fast_path_traces": ffk.fast_path_count,

@@ -13,7 +13,10 @@
  * policy (depth bounds + fallback threshold) on the verifier — the
  * per-request depth controller that keeps spec decoding from ever
  * losing to plain incremental decoding, engaged identically for
- * embedded C hosts and the Python stack.
+ * embedded C hosts and the Python stack. The same object arms the
+ * shared-prefix KV cache ("prefix_cache"/"prefix_cache_tokens"): a
+ * second request reusing the first one's prompt as its prefix skips
+ * those prefill FLOPs, observable below via the ffsv_prefix_* metrics.
  *
  * With a second argument — a directory holding an HF-layout checkpoint
  * (config.json + model.safetensors, as written by
@@ -41,12 +44,14 @@
   "\"num_attention_heads\": 4, \"num_key_value_heads\": 2, "            \
   "\"max_position_embeddings\": 64}"
 
-/* verifier: 4 layers + the adaptive-speculation policy */
+/* verifier: 4 layers + the adaptive-speculation policy + the
+ * shared-prefix KV pool (4096-token budget) */
 #define VERIFIER_JSON                                                   \
   "{" MODEL_CORE(4) ", \"generation_config\": {"                        \
   "\"adaptive\": true, \"spec_depth\": 3, \"min_spec_depth\": 1, "      \
   "\"fallback_margin\": 0.95, \"recover_margin\": 1.05, "               \
-  "\"probe_every\": 4}}"
+  "\"probe_every\": 4, "                                                \
+  "\"prefix_cache\": true, \"prefix_cache_tokens\": 4096}}"
 
 /* drafts: two truncations proposing into one merged token tree */
 #define DRAFTS_JSON                                                     \
@@ -97,6 +102,28 @@ int main(int argc, char **argv) {
     return 1;
   }
   printf("controller metrics present (ffsv_spec_effective_depth)\n");
+  free(snap);
+
+  /* Shared-prefix KV reuse: the finished request's prompt is now in the
+   * radix pool, so a request extending it matches at admission and
+   * skips the shared prefill. The pool's behavior is part of the
+   * metrics surface (hits/misses/evictions, shared tokens, occupancy);
+   * the exact-token-identity contract is asserted by the Python tests. */
+  int32_t p_reuse[] = {5, 9, 23, 7, 40, 41};
+  long g_reuse = ffsv_register_request(pair, p_reuse, 6, 4);
+  if (g_reuse < 0 || ffsv_generate_spec(pair, 3) != 1 ||
+      ffsv_request_status(pair, g_reuse) != 0) {
+    fprintf(stderr, "prefix-reuse generate failed: %s\n", ffsv_last_error());
+    return 1;
+  }
+  snap = ffsv_metrics_dump("json");
+  if (!snap || !strstr(snap, "ffsv_prefix_cache_hits_total") ||
+      !strstr(snap, "ffsv_prefix_shared_tokens_total") ||
+      !strstr(snap, "ffsv_prefix_pool_tokens")) {
+    fprintf(stderr, "prefix-cache metrics missing: %s\n", ffsv_last_error());
+    return 1;
+  }
+  printf("prefix cache engaged (ffsv_prefix_* metrics present)\n");
   free(snap);
 
   /* Overload-safety surface: cancellation + per-request timeouts.

@@ -308,9 +308,11 @@ class LLM:
                 tenant=tenant, priority=priority) for p in prompts]
             if self.ssms:
                 self.rm.generate_spec_infer(
-                    self.ffmodel, [s.ffmodel for s in self.ssms])
+                    self.ffmodel, [s.ffmodel for s in self.ssms],
+                    generation_config=self.generation_config)
             else:
-                self.rm.generate_incr_decoding(self.ffmodel)
+                self.rm.generate_incr_decoding(
+                    self.ffmodel, generation_config=self.generation_config)
         # prompt order, not completion order (results[i] pairs with prompts[i])
         results = [self.rm.results[g] for g in guids]
         return results[0] if single else results
@@ -492,12 +494,15 @@ class _BackgroundServer:
                         ev.set()
                     return
             try:
+                gen_cfg = getattr(self.llm, "generation_config", None)
                 if self.llm.ssms:
                     done = rm.generate_spec_infer(
                         self.llm.ffmodel,
-                        [s.ffmodel for s in self.llm.ssms])
+                        [s.ffmodel for s in self.llm.ssms],
+                        generation_config=gen_cfg)
                 else:
-                    done = rm.generate_incr_decoding(self.llm.ffmodel)
+                    done = rm.generate_incr_decoding(
+                        self.llm.ffmodel, generation_config=gen_cfg)
             except BaseException as e:       # surface to submitters
                 # fail every in-flight AND queued request with this error
                 # (each gets a status="error" result), then release all

@@ -68,6 +68,17 @@ class GenerationConfig:
     spec_probe_every: int = 4            # fallback blocks between probes
     spec_ewma_alpha: float = 0.4
     spec_draft_cost_ratio: float = 0.0   # 0 = estimate from param bytes
+    # --- shared-prefix KV cache (serve/prefix_cache.py, ISSUE 19) ---
+    # Off by default: arming it attaches a refcounted radix pool to the
+    # RequestManager — admission-time longest-prefix match, grant-time
+    # KV install (those prefill FLOPs skipped), insert-on-finish of
+    # newly seen prompts. Token-identical to the no-reuse path (greedy
+    # decode depends only on the token prefix). With the cache on, the
+    # incremental path runs the host scheduler loop (the pool lives
+    # host-side). prefix_cache_tokens is the pool budget in tokens
+    # (0 = prefix_cache.DEFAULT_POOL_TOKENS).
+    prefix_cache: bool = False
+    prefix_cache_tokens: int = 0
 
 
 @jax.tree_util.register_dataclass

@@ -156,6 +156,10 @@ class _ServingHost:
         # adaptive-speculation / sampling policy parsed from the spec
         # JSON's "generation_config" (None -> library defaults)
         self.gen_cfg = gen_cfg
+        # attach the shared-prefix pool EAGERLY (not lazily at the first
+        # generate) so ffsv_register_request calls made before the loop
+        # starts still get admission-time prefix matching
+        self.rm._resolve_prefix_cache(gen_cfg)
 
 
 # spec-JSON "generation_config" keys -> GenerationConfig fields. Short C
@@ -175,6 +179,8 @@ _GEN_CFG_KEYS = {
     "do_sample": "do_sample",
     "temperature": "temperature",
     "topp": "topp",
+    "prefix_cache": "prefix_cache",
+    "prefix_cache_tokens": "prefix_cache_tokens",
 }
 
 
@@ -215,6 +221,10 @@ def _parse_generation_config(spec: dict):
          and gc.spec_draft_cost_ratio >= 0, ">= 0 (0 = estimate)"),
         ("timeout_s", isinstance(gc.timeout_s, (int, float))
          and gc.timeout_s >= 0, ">= 0 (0 = no timeout)"),
+        ("prefix_cache", isinstance(gc.prefix_cache, bool), "a boolean"),
+        ("prefix_cache_tokens", isinstance(gc.prefix_cache_tokens, int)
+         and gc.prefix_cache_tokens >= 0,
+         "an int >= 0 (pool tokens; 0 = default)"),
     )
     for key, ok, want in checks:
         if not ok:
@@ -236,9 +246,11 @@ def llm_create(cfg, spec_json: str) -> _ServingHost:
        "quantize": "int8" | "int4" | "none" (optional — weight-only
        compression applied after the weights land, the
        quantize-on-load cold-start path),
-       "generation_config": {<adaptive speculation / sampling knobs>}
-       (optional — see _GEN_CFG_KEYS; e.g. {"adaptive": true,
-       "spec_depth": 6, "min_spec_depth": 1, "fallback_margin": 0.95})}``
+       "generation_config": {<adaptive speculation / sampling /
+       prefix-cache knobs>} (optional — see _GEN_CFG_KEYS; e.g.
+       {"adaptive": true, "spec_depth": 6, "min_spec_depth": 1,
+       "fallback_margin": 0.95, "prefix_cache": true,
+       "prefix_cache_tokens": 65536})}``
 
     The reference counterpart chains flexflow_model_create, the per-op
     builder calls, FileDataLoader weight load and init_operators_inference
@@ -365,7 +377,8 @@ def generate(host: _ServingHost) -> int:
     """Run incremental decoding for every pending request (reference
     flexflow_model_generate, flexflow_c.cc:1584). Returns the number of
     finished requests; outputs are fetched per-request afterwards."""
-    results = host.rm.generate_incr_decoding(host.model)
+    results = host.rm.generate_incr_decoding(
+        host.model, generation_config=host.gen_cfg)
     for r in results:
         host.results[r.guid] = [int(t) for t in r.output_tokens]
     return len(results)

@@ -96,7 +96,15 @@ class WorkloadSpec:
     Lengths are drawn from the discrete distributions given by
     ``prompt_lens``/``prompt_weights`` (uniform when weights omitted) —
     discrete mixes reproduce the bimodal short-chat/long-document shape
-    real traffic has without dragging in a trace corpus."""
+    real traffic has without dragging in a trace corpus.
+
+    ``shared_prefix_groups``/``shared_prefix_len`` model multi-tenant
+    system prompts: when both are > 0, each request's prompt is one of N
+    seeded group prefixes (drawn once per schedule) followed by a
+    per-request random suffix of the drawn prompt length — the workload
+    shape prefix-sharing KV caching (serve/prefix_cache.py) feeds on.
+    Defaults off, and when off the rng draw order is untouched, so
+    pre-existing seeded schedules stay byte-identical."""
 
     prompt_lens: Sequence[int] = (4, 8, 16)
     prompt_weights: Optional[Sequence[float]] = None
@@ -104,6 +112,9 @@ class WorkloadSpec:
     output_weights: Optional[Sequence[float]] = None
     tenants: Sequence[TenantSpec] = (TenantSpec(),)
     vocab_size: int = 128
+    # shared-prefix mix: N distinct system prompts of this token length
+    shared_prefix_groups: int = 0
+    shared_prefix_len: int = 0
 
     def _norm(self, weights, n):
         w = np.ones(n) if weights is None else np.asarray(weights, float)
@@ -159,6 +170,15 @@ def build_schedule(spec: WorkloadSpec, n_requests: int, rate_rps: float,
     pw = spec._norm(spec.prompt_weights, len(pl))
     ol = np.asarray(spec.output_lens, int)
     ow = spec._norm(spec.output_weights, len(ol))
+    # shared-prefix mix: draw the N group "system prompts" up front from
+    # the same rng (extra draws only happen when the mix is armed, so
+    # legacy schedules keep their byte-identical draw order)
+    prefixes = []
+    if spec.shared_prefix_groups > 0 and spec.shared_prefix_len > 0:
+        prefixes = [[int(t) for t in
+                     rng.randint(1, spec.vocab_size,
+                                 size=spec.shared_prefix_len)]
+                    for _ in range(spec.shared_prefix_groups)]
     out = []
     for i in range(n_requests):
         tenant = tenants[rng.choice(len(tenants), p=tw)]
@@ -166,6 +186,8 @@ def build_schedule(spec: WorkloadSpec, n_requests: int, rate_rps: float,
         n_out = int(ol[rng.choice(len(ol), p=ow)])
         prompt = [int(t) for t in
                   rng.randint(1, spec.vocab_size, size=n_prompt)]
+        if prefixes:
+            prompt = prefixes[rng.choice(len(prefixes))] + prompt
         out.append(LoadRequest(idx=i, arrival_s=float(arrivals[i]),
                                tenant=tenant.name, prompt=prompt,
                                max_new_tokens=n_out,
@@ -194,7 +216,8 @@ class EngineHandle:
             self.ffmodel = ffmodel
 
     def __init__(self, ffmodel, ssms: Sequence = (), rm=None,
-                 spec_depth: Optional[int] = None):
+                 spec_depth: Optional[int] = None,
+                 generation_config=None):
         from flexflow_tpu.serve.request_manager import RequestManager
 
         self.ffmodel = ffmodel
@@ -202,6 +225,10 @@ class EngineHandle:
         self.rm = rm if rm is not None else RequestManager()
         if spec_depth is not None:
             self.rm.max_spec_depth = spec_depth
+        # threaded into the scheduler loops by _BackgroundServer._run,
+        # exactly like serve.api.LLM.generation_config (arms prefix
+        # caching / spec-controller knobs for checkpoint-less models)
+        self.generation_config = generation_config
         self._server = None
 
     def start_server(self, admission=None):
@@ -249,6 +276,9 @@ class RequestRecord:
     # times the request was re-dispatched to a surviving replica after a
     # crash (serve/replica.py); 0 on a single-engine run
     failovers: int = 0
+    # prompt tokens whose KV came from the shared-prefix pool instead of
+    # being prefilled (serve/prefix_cache.py); 0 with the cache off
+    prefix_hit_tokens: int = 0
 
     @property
     def finished_s(self) -> float:
@@ -362,7 +392,8 @@ class LoadRunner:
                 latency_s=res.latency_s, ttft_s=res.ttft_s,
                 queue_wait_s=res.queue_wait_s, prefill_s=res.prefill_s,
                 deadline_s=req.deadline_s, status=res.status,
-                failovers=getattr(res, "failovers", 0)))
+                failovers=getattr(res, "failovers", 0),
+                prefix_hit_tokens=getattr(res, "prefix_hit_tokens", 0)))
         records.extend(records_rejected)
         records.sort(key=lambda r: r.idx)
         return records
@@ -485,6 +516,14 @@ def summarize(records: Sequence[RequestRecord],
         "queue_wait_mean_s": round(mean_qw, 4),
         "service_mean_s": round(mean_lat - mean_qw, 4),
         "queue_wait_fraction": round(mean_qw / max(mean_lat, 1e-9), 4),
+        # shared-prefix reuse: how many prompt tokens the KV pool served
+        # instead of the prefill step, and what was actually prefilled
+        # per request after reuse (the FLOP-savings proxy the
+        # serving_prefix bench gate tracks)
+        "prefix_hit_tokens_total": sum(r.prefix_hit_tokens for r in served),
+        "prefill_tokens_per_request": (round(
+            sum(r.prompt_tokens - r.prefix_hit_tokens for r in served)
+            / len(served), 2) if served else 0.0),
     }
     tenants = sorted({r.tenant for r in recs})
     if len(tenants) > 1:

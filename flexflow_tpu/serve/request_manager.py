@@ -94,6 +94,17 @@ class Request:
     # preemption re-queues, and joins this request's Chrome-trace spans
     # across replica pid rows. "" = minted locally at registration.
     trace_id: str = ""
+    # shared-prefix KV cache (serve/prefix_cache.py, ISSUE 19):
+    # prefix_entry holds a refcounted pool handle from the admission-time
+    # radix match (released at _collect); prefix_len is how many leading
+    # prompt positions the pooled segment covers (installed into the
+    # slot's KV at grant, skipping those prefill FLOPs — and again after
+    # a preemption re-queue resets cache_depth). prefix_hit_tokens rides
+    # onto the GenerationResult for loadgen's reuse accounting.
+    prefix_entry: Any = None
+    prefix_len: int = 0
+    prefix_hit_tokens: int = 0
+    prefix_checked: bool = False
 
     def __post_init__(self):
         if not self.tokens:
@@ -144,6 +155,9 @@ class GenerationResult:
     failovers: int = 0
     # fleet-wide correlation id (see Request.trace_id)
     trace_id: str = ""
+    # leading prompt tokens served from the shared-prefix KV pool
+    # (serve/prefix_cache.py) — prefill FLOPs skipped; 0 = cold prefill
+    prefix_hit_tokens: int = 0
 
 
 class RequestManager:
@@ -172,6 +186,12 @@ class RequestManager:
         # explicit ServingTelemetry, or None -> the process-global one
         # (resolved per loop iteration, so enabling mid-session attaches)
         self.telemetry = telemetry
+        # shared-prefix KV pool (serve/prefix_cache.PrefixCache), or
+        # None = feature off. Attached directly, or lazily from
+        # GenerationConfig.prefix_cache at the first generate call —
+        # once attached it persists across generate calls so pooled
+        # prefixes survive between serving rounds.
+        self.prefix_cache = None
 
     def _tel(self):
         return self.telemetry if self.telemetry is not None \
@@ -228,6 +248,11 @@ class RequestManager:
                       trace_id=trace_id or mint_trace_id(),
                       failovers=int(failovers),
                       preemptions=int(preemptions))
+        if self.prefix_cache is not None:
+            # admission-time prefix detection (ISSUE 19): the radix
+            # lookup + refcount happen here so eviction pressure between
+            # admission and slot grant can never pull the segment away
+            self._prefix_match(req)
         self.pending.append(req)
         self.inflight[guid] = req
         tel = self._tel()
@@ -288,6 +313,11 @@ class RequestManager:
         return req.finished
 
     def _collect(self, req: Request) -> GenerationResult:
+        if req.prefix_entry is not None and self.prefix_cache is not None:
+            # drop the pool refcount taken at admission (every terminal
+            # path funnels through _collect, so no handle leaks)
+            self.prefix_cache.release(req.prefix_entry)
+            req.prefix_entry = None
         out = req.tokens[len(req.prompt_tokens):]
         now = time.perf_counter()
         res = GenerationResult(
@@ -304,7 +334,8 @@ class RequestManager:
             status=req.status, timed_out=req.status == "timed_out",
             cancelled=req.status == "cancelled", error=req.error,
             tenant=req.tenant, preemptions=req.preemptions,
-            failovers=req.failovers, trace_id=req.trace_id)
+            failovers=req.failovers, trace_id=req.trace_id,
+            prefix_hit_tokens=req.prefix_hit_tokens)
         self.inflight.pop(req.guid, None)
         tel = self._tel()
         if tel is not None:
@@ -453,6 +484,100 @@ class RequestManager:
         return max(1, min(req.max_new_tokens - req.num_generated,
                           limit - len(req.tokens)))
 
+    # -- shared-prefix KV cache (serve/prefix_cache.py, ISSUE 19) ----------
+    def _resolve_prefix_cache(self, gc: Optional[GenerationConfig]):
+        """Lazily attach the pool when the generation config asks for it
+        (embedded hosts attach eagerly via capi_host so admission-time
+        matching covers requests registered before the loop starts)."""
+        if (gc is not None and gc.prefix_cache
+                and self.prefix_cache is None):
+            from flexflow_tpu.serve.prefix_cache import PrefixCache
+
+            self.prefix_cache = PrefixCache(
+                max_tokens=gc.prefix_cache_tokens)
+
+    def _prefix_match(self, req: Request):
+        """Longest-prefix radix lookup for one request (admission time,
+        or grant time for requests admitted before the pool existed)."""
+        pc = self.prefix_cache
+        req.prefix_checked = True
+        if pc is None:
+            return
+        shared, entry = pc.match(req.prompt_tokens)
+        if entry is not None:
+            req.prefix_entry = entry
+            req.prefix_len = shared
+            req.prefix_hit_tokens = shared
+        tel = self._tel()
+        if tel is not None:
+            tel.note_prefix_lookup(shared, pc.pool_tokens)
+
+    def _prefix_install(self, active, pairs):
+        """Grant-time KV install: any slotted request holding a pool
+        handle with an empty cache (fresh grant, or a preemption
+        re-queue that reset cache_depth) gets the shared positions
+        copied into its slot caches, and its depth bookkeeping advanced
+        past them — those prefill FLOPs are simply skipped. ``pairs``
+        is the loop's ordered [("llm", ifm), ("ssm0", ifm), ...]."""
+        pc = self.prefix_cache
+        if pc is None:
+            return
+        from flexflow_tpu.serve import prefix_cache as pcm
+
+        for req in active:
+            if req is None or req.finished or req.slot < 0:
+                continue
+            if not req.prefix_checked:
+                self._prefix_match(req)
+            entry = req.prefix_entry
+            if entry is None or req.cache_depth != 0:
+                continue
+            n = min(req.prefix_len, len(req.tokens) - 1)
+            if n <= 0:
+                continue
+            for key, ifm in pairs:
+                segs = entry.segments.get(key)
+                if segs is None or not pcm.prefix_compatible(
+                        ifm.model.op_state, segs, n):
+                    continue    # this model prefills the prefix cold
+                ifm.model.op_state = pcm.install_prefix_kv(
+                    ifm.model.op_state, req.slot, segs, n)
+                if key == "llm":
+                    req.cache_depth = n
+                else:
+                    req.ssm_cache_depth[int(key[3:])] = n
+
+    def _prefix_store(self, req: Request, pairs):
+        """Insert-on-finish: pool the finished request's prompt KV
+        straight out of its still-intact slot (called before the slot is
+        cleared). Models whose cache never covered the whole prompt
+        (e.g. a draft parked by the controller) are skipped — a later
+        reuse just prefills that model cold."""
+        pc = self.prefix_cache
+        if pc is None or req.slot < 0 or req.status != "ok":
+            return
+        prompt = req.prompt_tokens
+        if req.cache_depth < len(prompt) or not pc.would_store(prompt):
+            return
+        from flexflow_tpu.serve import prefix_cache as pcm
+
+        segments = {}
+        for key, ifm in pairs:
+            depth = (req.cache_depth if key == "llm"
+                     else req.ssm_cache_depth.get(int(key[3:]), 0))
+            if depth < len(prompt):
+                continue
+            segs = pcm.extract_prefix_kv(ifm.model.op_state, req.slot,
+                                         len(prompt))
+            if segs is not None:
+                segments[key] = segs
+        if "llm" not in segments:
+            return
+        _entry, evicted = pc.insert(prompt, segments)
+        tel = self._tel()
+        if tel is not None:
+            tel.note_prefix_store(evicted, pc.pool_tokens)
+
     # -- telemetry hooks (all no-ops when telemetry is disabled) -----------
     @staticmethod
     def _note_first_token(req: Request):
@@ -534,11 +659,15 @@ class RequestManager:
     # =====================================================================
     # Incremental decoding (reference generate_incr_decoding :1810)
     # =====================================================================
-    def generate_incr_decoding(self, model) -> List[GenerationResult]:
+    def generate_incr_decoding(self, model,
+                               generation_config:
+                               Optional[GenerationConfig] = None
+                               ) -> List[GenerationResult]:
         ifm = getattr(model, "_inference_manager", None)
         if ifm is None:
             ifm = model._inference_manager = InferenceManager(model)
         cfg = model.config
+        self._resolve_prefix_cache(generation_config)
         if getattr(cfg, "use_native_scheduler", True):
             # Only the library load/construction may fall back; device
             # errors inside the generation loop must propagate (requests
@@ -561,6 +690,10 @@ class RequestManager:
                     needs_host = needs_host or any(
                         r.deadline_s or r.cancel_requested
                         for r in self.pending)
+                # the shared-prefix pool (and its decode-interleaved
+                # prefill) lives host-side; the C++ scheduler owns its
+                # own serial prefill bookkeeping
+                needs_host = needs_host or self.prefix_cache is not None
                 if not needs_host:
                     return self._generate_incr_native(model, ifm, cfg,
                                                       sched)
@@ -574,6 +707,12 @@ class RequestManager:
             tel = self._tel()
             self._reap_expired(active, max_seq, done)
             self._fill_slots(active, max_seq, done)
+            self._prefix_install(active, (("llm", ifm),))
+            # decode-interleaved chunked prefill (ISSUE 19): each engine
+            # round dispatches at most ONE bounded prefill chunk AND the
+            # decode block for already-caught-up slots — a queued short
+            # request's TTFT no longer tracks the longest resident
+            # prompt's full prefill.
             rows = self._prefill_rows(active, chunk,
                                       lambda r: r.cache_depth,
                                       cfg.max_tokens_per_batch)
@@ -583,12 +722,14 @@ class RequestManager:
                 self._timed_prefill(ifm, meta, tel, rows, active)
                 for slot, chunk_toks, sp in rows:
                     active[slot].cache_depth = sp + len(chunk_toks)
-                continue
-            # decode: every unfinished slot feeds its pending token; the
+            # decode: every caught-up slot feeds its pending token; the
             # token-feedback loop runs fused on device (DECODE_BLOCK steps
             # per call); EOS/length overshoot is reconciled host-side.
+            # Mid-prefill slots (cache_depth short of the pending token)
+            # sit this block out.
             live = [req for req in active
-                    if req is not None and not req.finished]
+                    if req is not None and not req.finished
+                    and req.cache_depth == len(req.tokens) - 1]
             if live:
                 # dynamic trip count: exactly the steps still needed, one
                 # compiled program regardless of size (engine.py). The
@@ -598,6 +739,10 @@ class RequestManager:
                 block = min(
                     max(self._remaining_budget(req, max_seq) for req in live),
                     cfg.decode_block_steps)
+                if rows:
+                    # prefill still pending: keep the decode block short
+                    # so the next chunk isn't starved behind it
+                    block = min(block, chunk)
                 tok = np.zeros((R,), np.int32)
                 pos = np.zeros((R,), np.int32)
                 act = np.zeros((R,), bool)
@@ -625,6 +770,7 @@ class RequestManager:
             for slot in range(R):
                 req = active[slot]
                 if req is not None and req.finished:
+                    self._prefix_store(req, (("llm", ifm),))
                     done.append(self._collect(req))
                     active[slot] = None
         return done
@@ -848,6 +994,7 @@ class RequestManager:
         """
         if generation_config is not None and generation_config.spec_depth:
             spec_depth = generation_config.spec_depth
+        self._resolve_prefix_cache(generation_config)
         widths = [s.config.max_beam_width for s in ssms]
         W = beam_width or max(widths)
         if any(w != W for w in widths):
@@ -908,7 +1055,13 @@ class RequestManager:
         """Host-stepped tree speculation: per-round draft (greedy chains or
         ``beam_width``-wide beam search), host-side tree merge, one verify
         step, KV commit. Slower than the fused engines (one dispatch per
-        phase) but supports beams and inference_debugging dumps."""
+        phase) but supports beams and inference_debugging dumps.
+
+        This debug path intentionally keeps the historical serial
+        drain-prefill-then-decode order and does not consult the
+        shared-prefix pool — per-op dumps stay phase-ordered. The
+        throughput loops (incremental, spec-chain, multi-SSM fused)
+        carry the ISSUE 19 interleaving + prefix reuse."""
         llm_ifm = getattr(llm, "_inference_manager", None)
         if llm_ifm is None:
             llm_ifm = llm._inference_manager = InferenceManager(llm)
@@ -1084,7 +1237,12 @@ class RequestManager:
                              and ctrl.in_fallback(req.guid)}
                             if ctrl is not None else ())
             self._fill_slots(active, max_seq, done, parked_guids)
-            # prompt prefill for both models (same path as incremental)
+            self._prefix_install(active, (("llm", llm_ifm),
+                                          ("ssm0", ssm_ifm)))
+            # prompt prefill for both models (same path as incremental);
+            # one bounded chunk per model per round — caught-up slots
+            # draft/decode below in the SAME round (decode-interleaved
+            # chunked prefill, ISSUE 19)
             prefilled = False
             for ifm, depth_of in ((llm_ifm, lambda r: r.cache_depth),
                                   (ssm_ifm,
@@ -1112,11 +1270,15 @@ class RequestManager:
                         else:
                             active[slot].ssm_cache_depth[0] = sp + len(toks)
                     prefilled = True
-            if prefilled:
-                continue
             live = [req for req in active
                     if req is not None and not req.finished]
-            if live:
+            # decode-interleaved chunked prefill: only slots whose
+            # VERIFIER cache is caught up join this round's spec/decode
+            # work; mid-prefill slots wait (their next chunk dispatches
+            # next round) instead of stalling everyone else.
+            ready = [req for req in live
+                     if req.cache_depth == len(req.tokens) - 1]
+            if ready:
                 # speculation must not run past the KV cache end: the verify
                 # pass writes at positions pos..pos+depth each round. A
                 # request can draft only with a full round of KV room (the
@@ -1124,9 +1286,9 @@ class RequestManager:
                 # case); cramped requests finish through the single-step
                 # path below. The device loop also guards per request and
                 # exits early once every budget is drafted.
-                roomy = [req for req in live
+                roomy = [req for req in ready
                          if max_seq - len(req.tokens) - 1 >= room_needed]
-                cramped = [req for req in live
+                cramped = [req for req in ready
                            if max_seq - len(req.tokens) - 1 < room_needed]
                 # controller partition: parked requests decode through the
                 # fused incremental block (same cost/tokens as plain
@@ -1134,6 +1296,16 @@ class RequestManager:
                 draftable, parked, rounds = self._partition_spec(
                     ctrl, tel, live, roomy,
                     min(cfg.spec_rounds_per_call, engine.max_rounds))
+                if prefilled:
+                    # prefill still pending somewhere: one spec round,
+                    # then back to the next chunk
+                    rounds = 1
+                # a draftable slot may still have a lagging draft cache
+                # mid-interleave (its SSM chunk dispatched above); it
+                # drafts next round, once healed
+                draftable = [req for req in draftable
+                             if req.ssm_cache_depth.get(0, 0)
+                             == len(req.tokens) - 1]
                 if cramped:
                     # cache nearly full: finish remaining tokens one by one
                     # through the non-fused single-step decode path
@@ -1224,6 +1396,8 @@ class RequestManager:
                 if req is not None and req.finished:
                     if ctrl is not None:
                         ctrl.drop(req.guid)
+                    self._prefix_store(req, (("llm", llm_ifm),
+                                             ("ssm0", ssm_ifm)))
                     done.append(self._collect(req))
                     active[slot] = None
         return done
@@ -1286,6 +1460,12 @@ class RequestManager:
                              and ctrl.in_fallback(req.guid)}
                             if ctrl is not None else ())
             self._fill_slots(active, max_seq, done, parked_guids)
+            self._prefix_install(
+                active, (("llm", llm_ifm),
+                         *((f"ssm{i}", m)
+                           for i, m in enumerate(ssm_ifms))))
+            # one bounded prefill chunk per model per round; caught-up
+            # slots spec/decode below in the SAME round (ISSUE 19)
             prefilled = False
             rows = self._prefill_rows(active, chunk, lambda r: r.cache_depth,
                                       cfg.max_tokens_per_batch)
@@ -1310,19 +1490,27 @@ class RequestManager:
                     for slot, toks, sp in rows:
                         active[slot].ssm_cache_depth[i] = sp + len(toks)
                     prefilled = True
-            if prefilled:
-                continue
             live = [req for req in active
                     if req is not None and not req.finished]
-            if not live:
+            # decode-interleaved chunked prefill: mid-prefill slots sit
+            # this round's spec/decode out (chain-path parity)
+            ready = [req for req in live
+                     if req.cache_depth == len(req.tokens) - 1]
+            if not ready:
                 continue
-            roomy = [req for req in live
+            roomy = [req for req in ready
                      if max_seq - len(req.tokens) >= room_needed]
-            cramped = [req for req in live
+            cramped = [req for req in ready
                        if max_seq - len(req.tokens) < room_needed]
             draftable, parked, rounds = self._partition_spec(
                 ctrl, tel, live, roomy,
                 min(cfg.spec_rounds_per_call, engine.max_rounds))
+            if prefilled:
+                rounds = 1      # see chain-path note
+            draftable = [req for req in draftable
+                         if all(req.ssm_cache_depth.get(i, 0)
+                                == len(req.tokens) - 1
+                                for i in range(B))]
             if cramped:
                 # cache nearly full: finish token by token (chain-path
                 # parity; the fused tree needs B*depth+1 staging slots)
@@ -1417,6 +1605,10 @@ class RequestManager:
                 if req is not None and req.finished:
                     if ctrl is not None:
                         ctrl.drop(req.guid)
+                    self._prefix_store(
+                        req, (("llm", llm_ifm),
+                              *((f"ssm{i}", m)
+                                for i, m in enumerate(ssm_ifms))))
                     done.append(self._collect(req))
                     active[slot] = None
         return done

@@ -51,6 +51,11 @@ ffsv_spec_acceptance_ewma        gauge      mean controller acceptance EWMA
 ffsv_jit_cache_misses_total      counter    engine block compiles (traces)
 ffsv_engine_retraces_total       counter    compiles BEYOND each engine's 1st
 ffsv_failovers_total             counter    crash re-dispatches to survivors
+ffsv_prefix_cache_hits_total     counter    admission lookups matching a prefix
+ffsv_prefix_cache_misses_total   counter    admission lookups with no match
+ffsv_prefix_cache_evictions_total counter   pooled prefixes LRU-evicted
+ffsv_prefix_shared_tokens_total  counter    prompt tokens served from the pool
+ffsv_prefix_pool_tokens          gauge      tokens held by the prefix pool
 ===============================  =========  =================================
 
 Fleet layer (this package's distributed half): ``fleet.FleetTelemetry``
@@ -228,6 +233,23 @@ class ServingTelemetry:
             "ffsv_failovers_total",
             "crash re-dispatches of in-flight/queued requests to "
             "surviving replicas (serve/replica.py)")
+        # shared-prefix KV cache (serve/prefix_cache.py, ISSUE 19)
+        self.prefix_hits = r.counter(
+            "ffsv_prefix_cache_hits_total",
+            "admission-time prefix lookups that matched a pooled prefix")
+        self.prefix_misses = r.counter(
+            "ffsv_prefix_cache_misses_total",
+            "admission-time prefix lookups with no usable match")
+        self.prefix_evictions = r.counter(
+            "ffsv_prefix_cache_evictions_total",
+            "pooled prefixes evicted (LRU, token-budget pressure)")
+        self.prefix_shared_tokens = r.counter(
+            "ffsv_prefix_shared_tokens_total",
+            "prompt tokens served from the shared-prefix pool "
+            "(prefill FLOPs skipped)")
+        self.prefix_pool_tokens = r.gauge(
+            "ffsv_prefix_pool_tokens",
+            "tokens currently held by the shared-prefix pool")
 
     # -- hooks (serve/request_manager.py, serve/engine.py) ---------------
     def note_admission(self, guid: int, prompt_tokens: int,
@@ -267,6 +289,26 @@ class ServingTelemetry:
         a deadline-at-risk higher-priority one takes its slot."""
         self.requests_preempted.inc()
         self.flight.record("preemption", guid=guid)
+
+    def note_prefix_lookup(self, shared_tokens: int, pool_tokens: int):
+        """One admission-time shared-prefix lookup (request_manager.
+        _prefix_match): hit/miss, tokens the slot will NOT re-prefill,
+        and the pool-occupancy gauge."""
+        if shared_tokens > 0:
+            self.prefix_hits.inc()
+            self.prefix_shared_tokens.inc(shared_tokens)
+        else:
+            self.prefix_misses.inc()
+        self.prefix_pool_tokens.set(pool_tokens)
+        self.flight.record("prefix_lookup", shared_tokens=shared_tokens)
+
+    def note_prefix_store(self, evicted: int, pool_tokens: int):
+        """One insert-on-finish into the shared-prefix pool
+        (request_manager._prefix_store), with how many LRU victims the
+        token budget claimed to make room."""
+        if evicted > 0:
+            self.prefix_evictions.inc(evicted)
+        self.prefix_pool_tokens.set(pool_tokens)
 
     def note_slot_grant(self, guid: int, slot: int):
         """One batch-slot grant (request_manager._grant): the queue-wait

@@ -228,10 +228,19 @@ char *ffsv_config_get(void *cfg, const char *key);   /* caller frees */
  *   "probe_every": int      (4)     fallback blocks between probe rounds
  *   "ewma_alpha": f         (0.4)   acceptance-EWMA smoothing
  *   "draft_cost_ratio": f   (0)     0 = estimate from parameter bytes
+ * plus the shared-prefix KV cache (serve/prefix_cache.py — requests
+ * whose prompts share a prefix with an earlier prompt skip those
+ * prefill FLOPs; token-identical to the no-reuse path):
+ *   "prefix_cache": bool        (false)  arm the refcounted radix pool
+ *   "prefix_cache_tokens": int  (0)      pool budget in KV tokens;
+ *                                        0 = library default (65536)
  * Unknown keys fail the create (ffsv_last_error) rather than running
  * with silently-defaulted policy. Controller state is observable via
  * ffsv_metrics_dump: ffsv_spec_effective_depth / _fallback_total /
- * _fallback_active / _acceptance_ewma. */
+ * _fallback_active / _acceptance_ewma; prefix-cache state via
+ * ffsv_prefix_cache_hits_total / _misses_total / _evictions_total,
+ * ffsv_prefix_shared_tokens_total and the ffsv_prefix_pool_tokens
+ * occupancy gauge. */
 void *ffsv_llm_create(void *cfg, const char *spec_json);
 
 /* Speculative-decoding pair: verifier (tree-verify) + draft SSM

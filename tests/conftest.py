@@ -30,7 +30,8 @@ import pytest  # noqa: E402
 # finish in seconds, so the reordering costs the heavier files nothing.
 _EARLY_FILES = ("test_loadgen.py", "test_telemetry.py",
                 "test_spec_controller.py", "test_overload.py",
-                "test_fleet.py", "test_observability.py")
+                "test_fleet.py", "test_observability.py",
+                "test_prefix_cache.py")
 
 
 def pytest_collection_modifyitems(session, config, items):

@@ -69,6 +69,11 @@ HEADLINES: Dict[str, float] = {
     "serving_overload.resolved_fraction": 0.01,
     # fleet line (ISSUE 17): crash chaos must keep resolving everything
     "serving_fleet.resolved_fraction": 0.01,
+    # prefix-caching line (ISSUE 19): fraction of prefill tokens the
+    # shared-prefix pool saved — a token COUNT ratio, so it's stable
+    # round over round (unlike knee_ratio, which quantizes to the sweep's
+    # 2x rate steps and is gated only by its absolute floor below).
+    "serving_prefix.prefix_saved_frac": 0.15,
 }
 
 # Lower-is-better headlines: metric -> relative RISE tolerance (fail when
@@ -115,6 +120,15 @@ FLOOR_GROUPS: Dict[str, Dict[str, float]] = {
         "serving_fleet.resolved_fraction": 1.0,
         "serving_fleet.alerts_fired_overload": 1.0,
         "serving_fleet.alerts_steady_ok": 1.0,
+    },
+    # ISSUE 19: with prefix reuse on, the saturation knee of the
+    # shared-prefix mix must sit strictly RIGHT of the no-reuse knee
+    # (the sweep's steps are 2x apart, so any real shift reads >= 2.0;
+    # 1.05 tolerates a future finer-grained sweep) and shared-prefix KV
+    # reuse must save at least a quarter of the prefilled tokens.
+    "serving_prefix": {
+        "serving_prefix.knee_ratio": 1.05,
+        "serving_prefix.prefix_saved_frac": 0.25,
     },
 }
 
