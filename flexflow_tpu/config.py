@@ -55,6 +55,15 @@ class FFConfig:
     # joint search: interleave algebraic GraphXfer rewrites with the
     # parallelization DP (reference GraphSearchHelper::base_optimize)
     enable_substitutions: bool = True
+    # default substitution vocabulary = the packaged full JSON rule file
+    # (reference graph_subst_3_v2.json schema; search/substitutions/).
+    # False reverts to the 5 builtin rules. An explicit
+    # substitution_json_path always wins over both.
+    use_json_rules: bool = True
+    # hard wall-clock bound (seconds) on each UnitySearch.optimize() joint
+    # loop — with the full rule vocabulary, budget alone does not bound
+    # match time on large graphs. 0 = unbounded.
+    search_deadline_s: float = 60.0
     # profiled re-rank of the top searched strategies with measured per-op
     # times (reference Op::measure_operator_cost). None = on for real
     # accelerators, off on the CPU simulator.

@@ -791,6 +791,7 @@ class FFModel:
             self.config.data_parallelism_degree = deg.get("data", 1)
             self.config.tensor_parallelism_degree = deg.get("model", 1)
             self.config.expert_parallelism_degree = deg.get("expert", 1)
+            self.config.sequence_parallelism_degree = deg.get("seq", 1)
             self.mesh = make_mesh(self.config)
             self.policy = ShardingPolicy(self.mesh)
         if self.config.export_strategy_file:
@@ -866,9 +867,24 @@ class FFModel:
         # argument shardings, so uncommitted zeros here would make the first
         # post-warmup call recompile every serving program once the donated
         # outputs come back with concrete placements.
-        self.op_state = jax.tree.map(
-            lambda x: jax.device_put(x, self.policy.replicated()),
-            self.op_state)
+        # KV caches additionally shard their S dim over a "seq" mesh axis
+        # (searched sequence-parallel plans — each device then holds S/deg
+        # cache rows and attention runs seq_sharded_attend).
+        def _commit_state(path, x):
+            name = ""
+            for p in reversed(path):
+                key = getattr(p, "key", None)
+                if isinstance(key, str):
+                    name = key
+                    break
+            if (name in ("k_cache", "v_cache", "k", "v")
+                    and getattr(x, "ndim", 0) >= 4):
+                return jax.device_put(
+                    x, self.policy.kv_cache_sharding(x.shape))
+            return jax.device_put(x, self.policy.replicated())
+
+        self.op_state = jax.tree_util.tree_map_with_path(
+            _commit_state, self.op_state)
 
         # --- branch-parallel (nonsequence split) execution plan: turn the
         # searched OpStrategy.branch tags into shard_map regions so the

@@ -256,6 +256,18 @@ def _attend(attrs, q, k_cache, v_cache, lengths, qpos, out_dtype, ctx,
     kc, vc = k_cache, v_cache
     if layer_idx is not None:
         kc, vc = k_cache[layer_idx], v_cache[layer_idx]
+    mesh = getattr(ctx, "mesh", None) if ctx is not None else None
+    seq_deg = (mesh.shape["seq"] if mesh is not None
+               and "seq" in getattr(mesh, "axis_names", ()) else 1)
+    if seq_deg > 1 and S % seq_deg == 0:
+        # searched sequence-parallel plan: the cache S dim is sharded over
+        # the mesh's "seq" axis — score local slices, reconcile the softmax
+        # with pmax/psum (parallel/ring_attention.seq_sharded_attend)
+        from flexflow_tpu.parallel.ring_attention import seq_sharded_attend
+        out = seq_sharded_attend(
+            q, kc[..., :D], vc[..., :D], lengths, qpos, mesh, bias=bias,
+            alibi=alibi, causal=causal, qk_scale=scale, out_dtype=out_dtype)
+        return out if append_kv is None else (out,) + new_caches
     out = reference_attend(
         q, kc[..., :D], vc[..., :D], lengths, qpos, bias=bias,
         alibi=alibi, causal=causal, qk_scale=scale, out_dtype=out_dtype)

@@ -123,3 +123,88 @@ def ring_attention(q, k, v, mesh: Mesh, seq_axis: str = "seq",
                  scale=scale)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec, check_vma=False)(q, k, v)
+
+
+_NEG_INF = -1e30   # finite "minus infinity", matches kernels/attention.py
+
+
+def seq_sharded_attend(q, k_cache, v_cache, lengths, qpos, mesh: Mesh,
+                       seq_axis: str = "seq", bias=None, alibi=None, *,
+                       causal=True, qk_scale=None, out_dtype=None):
+    """Sequence-sharded serving attention over the KV cache.
+
+    The execution target for a searched plan whose attention strategy
+    shards the sequence dim: same contract as the dense oracle
+    (``kernels.attention.reference_attend`` — q ``[R, Q, H, D]``, caches
+    ``[R, KH, S, D]``, ``lengths [R]`` valid extents, ``qpos [R, Q]``
+    absolute positions, optional additive ``bias [R, Q, S]`` and ALiBi
+    slopes), but the cache's S dim lives sharded over ``seq_axis`` and each
+    shard scores only its local slice against the replicated queries.
+
+    The softmax is reconciled exactly: global row max via ``lax.pmax``,
+    then one ``lax.psum`` for the denominator and one for the weighted-V
+    numerator — so the output is token-identical to the unsharded
+    reference. Decode (Q == 1) and chunked prefill (Q > 1) take the same
+    path: queries are tiny relative to a 32k cache, so replicating them
+    and partitioning the cache needs no ring rotation at all — three small
+    collectives per step replace (deg-1) KV-shard rotations, and each
+    device streams S/deg cache rows instead of S.
+    """
+    R, Q, H, D = q.shape
+    KH = k_cache.shape[1]
+    G = H // KH
+    if qk_scale is None:
+        qk_scale = 1.0 / math.sqrt(D)
+    out_dtype = out_dtype or q.dtype
+    deg = mesh.shape[seq_axis] if seq_axis in mesh.axis_names else 1
+    if deg <= 1 or k_cache.shape[2] % deg != 0:
+        from flexflow_tpu.kernels.attention import reference_attend
+
+        return reference_attend(q, k_cache, v_cache, lengths, qpos,
+                                bias=bias, alibi=alibi, causal=causal,
+                                qk_scale=qk_scale, out_dtype=out_dtype)
+
+    has_bias = bias is not None
+    has_alibi = alibi is not None
+
+    def local_fn(q, kc, vc, lengths, qpos, *rest):
+        rest = list(rest)
+        b = rest.pop(0) if has_bias else None
+        al = rest.pop(0) if has_alibi else None
+        idx = lax.axis_index(seq_axis)
+        SL = kc.shape[2]
+        qg = q.reshape(R, Q, KH, G, D)
+        kcl = kc.astype(q.dtype)
+        vcl = vc.astype(q.dtype)
+        s = jnp.einsum("rqkgd,rksd->rkgqs", qg, kcl,
+                       preferred_element_type=jnp.float32) * qk_scale
+        s_ids = (idx * SL + jnp.arange(SL))[None, None, :]   # global key ids
+        if al is not None:
+            dist = (qpos[:, :, None] - s_ids).astype(jnp.float32)
+            slopes = al.astype(jnp.float32).reshape(KH, G)
+            s = s - slopes[None, :, :, None, None] * dist[:, None, None, :, :]
+        if b is not None:
+            s = s + b.astype(jnp.float32)[:, None, None, :, :]
+        visible = jnp.ones((R, Q, SL), bool) if not causal else \
+            (s_ids <= qpos[:, :, None])
+        visible = visible & (s_ids < lengths[:, None, None])
+        s = jnp.where(visible[:, None, None, :, :], s, _NEG_INF)
+        m = lax.pmax(s.max(axis=-1), seq_axis)           # global row max
+        p = jnp.exp(s - m[..., None])
+        den = lax.psum(p.sum(axis=-1), seq_axis)
+        p = p / jnp.maximum(den, 1e-30)[..., None]
+        out = jnp.einsum("rkgqs,rksd->rqkgd", p.astype(q.dtype), vcl)
+        out = lax.psum(out, seq_axis)
+        return out.reshape(R, Q, H * D).astype(out_dtype)
+
+    cache_spec = P(None, None, seq_axis, None)
+    args = [q, k_cache, v_cache, lengths, qpos]
+    in_specs = [P(), cache_spec, cache_spec, P(), P()]
+    if has_bias:
+        args.append(bias)
+        in_specs.append(P(None, None, seq_axis))
+    if has_alibi:
+        args.append(alibi)
+        in_specs.append(P())
+    return shard_map(local_fn, mesh=mesh, in_specs=tuple(in_specs),
+                     out_specs=P(), check_vma=False)(*args)

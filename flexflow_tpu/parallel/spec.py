@@ -75,6 +75,20 @@ class ShardingPolicy:
                 spec.append(None)
         return NamedSharding(self.mesh, P(*spec))
 
+    def kv_cache_sharding(self, shape: Tuple[int, ...]) -> NamedSharding:
+        """KV-cache buffers [R, KH, S, D] (or stacked [L, R, KH, S, D]):
+        shard the sequence dim (dim -2) over 'seq' when the mesh has one
+        and it divides — the storage layout consumed by
+        parallel.ring_attention.seq_sharded_attend, so a searched
+        sequence-parallel plan holds S/deg cache rows per device instead
+        of the whole context. Falls back to replication otherwise."""
+        shape = tuple(shape)
+        spec = [None] * len(shape)
+        if (len(shape) >= 2 and self._axis("seq")
+                and shape[-2] % self.mesh.shape["seq"] == 0):
+            spec[-2] = "seq"
+        return NamedSharding(self.mesh, P(*spec))
+
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
 

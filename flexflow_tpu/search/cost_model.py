@@ -24,7 +24,7 @@ import numpy as np
 
 from flexflow_tpu.ffconst import OpType
 from flexflow_tpu.search.machine_model import MachineModel
-from flexflow_tpu.search.pcg import PCG, PCGNode
+from flexflow_tpu.search.pcg import ATTENTION_OPS, PCG, PCGNode
 from flexflow_tpu.search.strategy import (
     OpStrategy, Spec, Strategy, shard_bytes, spec_degree,
 )
@@ -147,6 +147,29 @@ class CostModel:
                 hb = shard_bytes(tuple(halo_shape), node.dtype_bytes,
                                  tuple(spec_wo), axes)
                 m.comm_time += 2.0 * self.machine.ppermute_time(hb)
+        # sequence-sharded attention rings its K/V blocks around the seq
+        # group (parallel/ring_attention.py): deg-1 neighbor rotations of
+        # the LOCAL K and V blocks each step. Without this charge a
+        # seq-sharded layout would look communication-free and always
+        # dominate — the exact blow-up the conv halo charge prevents for
+        # conv-sp. Unlike a TP psum (a dependency barrier after the op),
+        # the rotations PIPELINE with the per-block attention compute
+        # (Liu et al. blockwise ring), so only the part the compute
+        # cannot hide is exposed.
+        if node.op_type in ATTENTION_OPS and node.input_shapes:
+            in_spec = (tuple(st.input_specs[0]) if st.input_specs
+                       else (None,) * len(node.input_shapes[0]))
+            seq_ax = in_spec[1] if len(in_spec) > 1 else None
+            deg = axes.get(seq_ax, 1) if seq_ax is not None else 1
+            if deg > 1:
+                local = shard_bytes(node.input_shapes[0], node.dtype_bytes,
+                                    in_spec, axes)
+                ring = (deg - 1) * self.machine.ppermute_time(2.0 * local)
+                m.comm_time += max(0.0, ring - fwd)
+                if self.training:
+                    # backward re-rings K/V plus their grads, hidden
+                    # under the (2x) backward compute
+                    m.comm_time += max(0.0, 2.0 * ring - m.backward_time)
         # gradient sync: a weight's grads must be allreduced over every
         # mesh axis the weight is REPLICATED over while the op's
         # activations are sharded over it — the data axis (classic DP

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import math
 import random
+import time
 from typing import Dict, List, Optional, Tuple
 
 from flexflow_tpu.search.cost_model import CostModel, CostMetrics
@@ -39,7 +40,8 @@ class UnitySearch:
                  budget: int = -1, alpha: float = 1.2,
                  mem_lambda: float = 0.0, rules=None,
                  enable_substitutions: bool = True,
-                 enable_nonsequence: bool = True):
+                 enable_nonsequence: bool = True,
+                 deadline_s: Optional[float] = None):
         self.pcg = pcg
         self.cm = cost_model
         self.axes = dict(axis_degrees)
@@ -49,6 +51,14 @@ class UnitySearch:
         # GraphSearchHelper::base_optimize, substitution.cc:2245)
         self.budget = budget if budget > 0 else 64
         self.alpha = alpha
+        # hard wall-clock bound on optimize(): with the full JSON rule
+        # vocabulary as the default, budget alone does not bound the match
+        # loop on large graphs — the deadline does (None = unbounded)
+        self.deadline_s = deadline_s
+        # nonsequence-split trials are full per-branch DPs + simulations;
+        # they share the joint budget (ADVICE.md: ungated unequal-split
+        # enumeration multiplied search time on large data axes)
+        self._nsq_trials = 0
         self.mem_lambda = mem_lambda
         self.enable_substitutions = enable_substitutions
         # sequence-only ablation switch: skip nonsequence (branch) splits
@@ -242,11 +252,30 @@ class UnitySearch:
                     trials.append(([deg // nb] * nb, axis))
             d = self.axes.get("data", 1)
             if nb == 2 and d >= 2:
-                # unequal vertical/horizontal params (i, d - i)
-                for i in range(1, d):
-                    if i != d - i:       # equal case covered above
+                # unequal vertical/horizontal params (i, d - i), capped per
+                # ADVICE.md: only power-of-two and slice-aligned device
+                # counts — the reference's VERTICAL (node-unit) splits are
+                # slice-aligned and its HORIZONTAL ones power-of-two, and
+                # the full range made a d=256 axis cost hundreds of
+                # branch DPs per fork-join
+                per_slice = self.cm.machine.devices_per_slice or 0
+                counts = set()
+                i = 1
+                while i < d:
+                    counts.update((i, d - i))
+                    i *= 2
+                if per_slice and d % per_slice == 0:
+                    counts.update(range(per_slice, d, per_slice))
+                for i in sorted(counts):
+                    if 0 < i < d and i != d - i:   # equal case covered above
                         trials.append(([i, d - i], "data"))
             for allocs, axis in trials:
+                # each trial is a full per-branch DP + simulation: charge
+                # it against the joint budget so fork-join-rich graphs
+                # stay bounded
+                if self._nsq_trials >= self.budget:
+                    return best
+                self._nsq_trials += 1
                 trial = self._branch_trial(pcg, best, branches, allocs,
                                            axis)
                 mt = self.cm.simulate(pcg, trial)
@@ -294,6 +323,12 @@ class UnitySearch:
         back onto original layer names)."""
         import heapq
 
+        t0 = time.monotonic()
+
+        def expired() -> bool:
+            return (self.deadline_s is not None
+                    and time.monotonic() - t0 > self.deadline_s)
+
         best_s = self.optimize_graph(self.pcg)
         self.best_graph = self.pcg
         self.top_candidates = [(best_s.cost, self.pcg, best_s)]
@@ -303,15 +338,38 @@ class UnitySearch:
 
         rules = self.rules if self.rules is not None else builtin_rules()
         xfers = [GraphXfer(r) for r in rules]
+        # Pre-filter the vocabulary: a rule whose src pattern names an op
+        # type no reachable graph can contain never matches, and with the
+        # full JSON rule set as the default most of the 600+ rules fall
+        # here. Fixpoint over dst-introduced types so a rule enabled only
+        # by another rule's rewrite still survives the filter.
+        types = {n.op_type for n in self.pcg.nodes}
+        remaining, active = list(xfers), []
+        changed = True
+        while changed:
+            changed = False
+            still = []
+            for x in remaining:
+                if x.src_types <= types:
+                    active.append(x)
+                    if not x.dst_types <= types:
+                        types |= x.dst_types
+                        changed = True
+                else:
+                    still.append(x)
+            remaining = still
+        xfers = active
         counter = 0
         heap = [(best_s.cost, counter, self.pcg)]
         seen = {_graph_signature(self.pcg)}
         evals = 1
-        while heap and evals < self.budget:
+        while heap and evals < self.budget and not expired():
             cost, _, g = heapq.heappop(heap)
             if cost > self.alpha * best_s.cost:
                 break                 # heap-ordered: the rest are worse
             for xfer in xfers:
+                if expired():
+                    break
                 for m in xfer.find_matches(g):
                     g2 = xfer.apply(g, m)
                     if g2 is None:
@@ -329,7 +387,7 @@ class UnitySearch:
                     if s2.cost <= self.alpha * best_s.cost:
                         counter += 1
                         heapq.heappush(heap, (s2.cost, counter, g2))
-                    if evals >= self.budget:
+                    if evals >= self.budget or expired():
                         break
                 if evals >= self.budget:
                     break
@@ -471,12 +529,19 @@ def optimize_model(model, chip: str = "cpu-sim",
     machine = _machine_for(config, chip, n)
     cfg_axes = {"data": config.data_parallelism_degree,
                 "model": config.tensor_parallelism_degree,
-                "expert": config.expert_parallelism_degree}
+                "expert": config.expert_parallelism_degree,
+                "seq": config.sequence_parallelism_degree}
     if config.only_data_parallel:
         cfg_axes["model"] = 1
         cfg_axes["expert"] = 1
+        cfg_axes["seq"] = 1
     pcg = PCG.from_model(model)
     budget = config.search_budget
+    # Substitution vocabulary: an explicit JSON path wins; otherwise the
+    # PACKAGED full rule file (reference graph_subst_3_v2.json schema) is
+    # the default — budget/alpha pruning, the per-search deadline, and
+    # optimize()'s reachable-op-type pre-filter keep the 600+ rules
+    # wall-clock-bounded. use_json_rules=False reverts to the 5 builtins.
     rules = None
     if config.substitution_json_path:
         from flexflow_tpu.search.substitution import (
@@ -484,6 +549,13 @@ def optimize_model(model, chip: str = "cpu-sim",
 
         rules = builtin_rules() + load_rules_json(
             config.substitution_json_path)
+    elif getattr(config, "use_json_rules", True):
+        from flexflow_tpu.search.substitution import (
+            builtin_rules, default_rules)
+
+        rules = builtin_rules() + default_rules()
+    deadline = (config.search_deadline_s
+                if getattr(config, "search_deadline_s", 0) > 0 else None)
     # profiled re-rank (reference measure_operator_cost): default on when a
     # real accelerator backs jax, off on the CPU simulator
     profile = config.search_profile
@@ -504,7 +576,8 @@ def optimize_model(model, chip: str = "cpu-sim",
                 pcg, cm_l, axes, budget=budget,
                 alpha=config.search_alpha, mem_lambda=lam, rules=rules,
                 enable_substitutions=config.enable_substitutions,
-                enable_nonsequence=enable_nonsequence)
+                enable_nonsequence=enable_nonsequence,
+                deadline_s=deadline)
             if cand_graphs is None:
                 # first attempt: full joint rewrite discovery
                 strategy = search.optimize()
@@ -556,9 +629,18 @@ def optimize_model(model, chip: str = "cpu-sim",
         for d in range(1, n + 1):
             if n % d != 0:
                 continue
-            cand = {"data": d, "model": n // d, "expert": 1}
-            if cand not in factorizations:
-                factorizations.append(cand)
+            # each divisor pairs the remaining devices with either the
+            # SEQUENCE axis or the tensor-parallel axis — the
+            # factorization the long-context (batch starves DP) regime
+            # needs. seq first: on a cost tie the adopted mesh then
+            # carries a real "seq" axis, which is what the executing
+            # attention path keys ring attention off
+            # (ops/attention.py mha_forward, serve decode/prefill).
+            for extra in ("seq", "model"):
+                cand = {"data": d, "model": 1, "expert": 1, "seq": 1}
+                cand[extra] = n // d
+                if cand not in factorizations:
+                    factorizations.append(cand)
     searched = [search_under(a) for a in factorizations]
     # never adopt a factorization whose λ search gave up over HBM when a
     # fitting one exists (the single-factorization path's "never
@@ -585,8 +667,8 @@ def data_parallel_model_strategy(model, chip: str = "cpu-sim",
     n = num_devices if num_devices is not None else \
         config.resolve_num_devices()
     machine = _machine_for(config, chip, n)   # same geometry as the search
-    # canonical DP = batch over ALL devices, model/expert axes unused
-    axes = {"data": n, "model": 1, "expert": 1}
+    # canonical DP = batch over ALL devices, model/expert/seq axes unused
+    axes = {"data": n, "model": 1, "expert": 1, "seq": 1}
     pcg = PCG.from_model(model)
     search = UnitySearch(pcg, CostModel(machine, axes, training=training),
                          axes, enable_substitutions=False,

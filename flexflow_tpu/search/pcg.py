@@ -24,6 +24,25 @@ DTYPE_BYTES = {
     DataType.DT_DOUBLE: 8, DataType.DT_INT4: 0.5, DataType.DT_INT8: 1,
 }
 
+ATTENTION_OPS = (
+    OpType.MULTIHEAD_ATTENTION,
+    OpType.INC_MULTIHEAD_SELF_ATTENTION,
+    OpType.SPEC_INC_MULTIHEAD_SELF_ATTENTION,
+    OpType.TREE_INC_MULTIHEAD_SELF_ATTENTION,
+)
+
+# Ops that admit sequence-dim (dim 1) sharding: attention rings its K/V
+# blocks (parallel/ring_attention.py), batch_matmul's M rows are
+# independent, and the norms reduce over the hidden dim only — so all of
+# them compute shard-locally once dim 1 is split. LINEAR and EMBEDDING
+# join so a pure data×seq mesh is viable END-TO-END (their dim-1 tokens
+# are independent; weights replicated): without them the long-context
+# factorization would leave every projection replicated and never win.
+SEQ_SHARD_OPS = set(ATTENTION_OPS) | {
+    OpType.BATCH_MATMUL, OpType.LAYERNORM, OpType.RMS_NORM,
+    OpType.LINEAR, OpType.EMBEDDING,
+}
+
 # Ops whose output follows their (first) input elementwise — they inherit
 # the producer's sharding at zero cost and add no decision of their own.
 ELEMENTWISE_OPS = {
@@ -128,6 +147,10 @@ class PCGNode:
         """
         data = "data" if axis_degrees.get("data", 1) > 1 else None
         model = "model" if axis_degrees.get("model", 1) > 1 else None
+        # sequence axis: a dedicated "seq" mesh axis when present, else
+        # ring over the TP group (the reference mesh only factors so many
+        # ways; sequence sharding over 'model' is still a valid layout)
+        seq = "seq" if axis_degrees.get("seq", 1) > 1 else model
         out_nd = len(self.output_shapes[0]) if self.output_shapes else 0
         in_specs = tuple(replicated(len(s)) for s in self.input_shapes)
         cands: List[OpStrategy] = [OpStrategy(
@@ -171,6 +194,9 @@ class PCGNode:
             elif t == OpType.EXPERTS:
                 add_expert_candidates(self, cands, data, model,
                                       axis_degrees)
+        if seq is not None and t in SEQ_SHARD_OPS:
+            # sequence-dim sharding + the data×sequence composite view
+            add_seq_candidates(self, cands, data, seq)
         # validity filter: a sharded dim must DIVIDE its axis degree —
         # the runtime's constrain()/weight_sharding fall back to
         # replicated otherwise (parallel/spec.py), so a non-dividing
@@ -280,6 +306,45 @@ def add_attention_candidates(node: PCGNode, cands: List[OpStrategy],
             input_specs=ins, output_spec=_batch(out_nd, dax),
             weight_specs=wspecs, partial_axes=(model,),
             name=f"tp-heads{'+dp' if dax else ''}"))
+
+
+def add_seq_candidates(node: PCGNode, cands: List[OpStrategy],
+                       data: Optional[str], seq: str):
+    """Sequence-dim parallelism — the missing attribute-dim family for the
+    long-context regime where batch=1 starves pure DP. Dim 1 (sequence /
+    batch_matmul M rows) is sharded over ``seq``; weights stay replicated
+    and there are no partial axes. Attention pays the K/V ring rotation
+    (parallel/ring_attention.py), charged by the cost model; batch_matmul
+    and layer/rms norms compute shard-locally (norms reduce over the
+    hidden dim only). The '+dp' variants are the two-axis composite
+    (data×sequence) views.
+
+    Requires a rank-3+ output: on a rank-2 [batch, feature] tensor dim 1
+    is a REDUCTION/feature dim (linear contraction, norm reduction) and
+    sharding it would need a partial-sum the strategy doesn't carry."""
+    out_nd = len(node.output_shapes[0]) if node.output_shapes else 0
+    if out_nd < 3:
+        return
+    t = node.op_type
+    for dax in ({None, data} if data else {None}):
+        def seq_spec(nd: int, shard_seq: bool = True) -> Spec:
+            spec = list(_batch(nd, dax))
+            if shard_seq and nd >= 2:
+                spec[1] = seq
+            return tuple(spec)
+
+        if t == OpType.BATCH_MATMUL:
+            # [B,M,K] @ [B,K,N]: output rows are independent, so only the
+            # M operand shards dim 1; the K×N operand rides replicated.
+            ins = tuple(seq_spec(len(s), shard_seq=(i == 0))
+                        for i, s in enumerate(node.input_shapes))
+        else:
+            ins = tuple(seq_spec(len(s)) for s in node.input_shapes)
+        cands.append(OpStrategy(
+            input_specs=ins, output_spec=seq_spec(out_nd),
+            weight_specs={w: replicated(len(s))
+                          for w, s in node.weight_shapes.items()},
+            name=f"seq{'+dp' if dax else ''}"))
 
 
 def add_embedding_candidates(node: PCGNode, cands: List[OpStrategy],

@@ -149,6 +149,16 @@ class GraphXfer:
     def __init__(self, rule: Rule):
         self.rule = rule
 
+    @property
+    def src_types(self) -> set:
+        """Op types the src pattern requires — the joint search pre-filters
+        rules whose src types no reachable graph contains."""
+        return {x.op_type for x in self.rule.src if x.op_type is not None}
+
+    @property
+    def dst_types(self) -> set:
+        return {x.op_type for x in self.rule.dst if x.op_type is not None}
+
     def find_matches(self, pcg: PCG) -> List[Dict[int, int]]:
         """All mappings pattern-op-idx → graph-node-idx. Backtracking over
         topo order, wildcard-free (reference find_matches substitution.cc:519
@@ -515,6 +525,32 @@ def load_rules_json(path: str, include_parallel: bool = False) -> List[Rule]:
                              m["srcTsId"]) for m in r.get("mappedOutput", [])],
         ))
     return out
+
+
+_DEFAULT_RULES_CACHE: Optional[List[Rule]] = None
+
+
+def default_rules_path() -> str:
+    """The packaged full-vocabulary rule file (reference
+    graph_subst_3_v2.json schema, regenerated by
+    tools/gen_default_rules.py)."""
+    import os
+
+    return os.path.join(os.path.dirname(__file__), "substitutions",
+                        "graph_subst_default.json")
+
+
+def default_rules() -> List[Rule]:
+    """The default substitution vocabulary for ``optimize_model``: the
+    packaged JSON rule set, parsed once per process. Missing/corrupt file
+    degrades to the empty list (the caller still has builtin_rules())."""
+    global _DEFAULT_RULES_CACHE
+    if _DEFAULT_RULES_CACHE is None:
+        try:
+            _DEFAULT_RULES_CACHE = load_rules_json(default_rules_path())
+        except (OSError, ValueError, KeyError):
+            _DEFAULT_RULES_CACHE = []
+    return _DEFAULT_RULES_CACHE
 
 
 def apply_substitutions(pcg: PCG, rules: Optional[List[Rule]] = None,

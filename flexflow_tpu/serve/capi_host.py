@@ -352,7 +352,8 @@ def register_request_timeout(host: _ServingHost, tokens: Sequence[int],
         timeout_s=float(timeout_s) if timeout_s > 0 else None)
 
 
-_STATUS_CODES = {"ok": 0, "timed_out": 1, "cancelled": 2, "error": 3}
+_STATUS_CODES = {"ok": 0, "timed_out": 1, "cancelled": 2, "error": 3,
+                 "rejected": 5}
 
 
 def request_cancel(host: _ServingHost, request_id: int) -> int:
@@ -364,7 +365,8 @@ def request_cancel(host: _ServingHost, request_id: int) -> int:
 
 def request_status(host: _ServingHost, request_id: int) -> int:
     """``ffsv_request_status``: -1 unknown, 0 ok, 1 timed_out,
-    2 cancelled, 3 error, 4 registered-but-unfinished."""
+    2 cancelled, 3 error, 4 registered-but-unfinished, 5 rejected
+    (prompt can never fit max_sequence_length)."""
     rid = int(request_id)
     res = host.rm.results.get(rid)
     if res is not None:

@@ -80,7 +80,7 @@ class Request:
     tenant: str = "default"
     priority: int = 0
     deadline_s: float = 0.0
-    status: str = "ok"                    # ok|timed_out|cancelled|error
+    status: str = "ok"            # ok|timed_out|cancelled|error|rejected
     error: str = ""
     cancel_requested: bool = False
     preemptions: int = 0
@@ -141,7 +141,9 @@ class GenerationResult:
     # terminal disposition (overload front door): "ok", "timed_out"
     # (deadline expired between rounds — output_tokens holds the partial
     # prefix generated so far), "cancelled" (host-side cancel), or
-    # "error" (the serving loop died; ``error`` carries the message).
+    # "error" (the serving loop died; ``error`` carries the message), or
+    # "rejected" (the prompt can never fit max_sequence_length; ``error``
+    # says so — long-context admission instead of a silent empty result).
     # Every registered request ALWAYS gets a result with one of these —
     # the every-future-resolves invariant serve/faultinject.py checks.
     status: str = "ok"
@@ -371,13 +373,27 @@ class RequestManager:
         del self.pending[best_i]
         return best
 
+    def _reject_overlong(self, req: Request, limit: int):
+        """Long-context admission: a prompt that can never fit the KV cache
+        is REJECTED with an explicit status + message instead of silently
+        resolving as an empty "ok" result (which callers could not tell
+        apart from a 0-token generation)."""
+        req.status = "rejected"
+        req.error = (
+            f"prompt length {len(req.prompt_tokens)} cannot fit "
+            f"max_sequence_length {limit}; raise max_sequence_length "
+            f"(sequence-parallel serving shards the KV cache over the "
+            f"mesh's 'seq' axis — see README, long-context serving) "
+            f"or shorten the prompt")
+        req.finished = True
+
     def _grant(self, req: Request, slot: int, active, max_seq: int,
                done: List[GenerationResult]) -> bool:
         """Place ``req`` in ``slot`` (rejecting over-long prompts straight
         to done, the reference behavior). True when the slot was taken."""
         limit = min(req.max_sequence_length or max_seq, max_seq)
         if len(req.prompt_tokens) >= limit:
-            req.finished = True
+            self._reject_overlong(req, limit)
             done.append(self._collect(req))
             return False
         req.slot = slot
@@ -845,7 +861,11 @@ class RequestManager:
                 req = unslotted.popleft()
                 limit = min(req.max_sequence_length or max_seq, max_seq)
                 if len(req.prompt_tokens) >= limit:
-                    continue     # C++ rejected it straight to done
+                    # C++ rejected it straight to done; stamp the explicit
+                    # rejection so drain() collects it as such
+                    self._reject_overlong(req, limit)
+                    req.finished = False   # drain() owns the terminal flip
+                    continue
                 req.prefill_start_s = now
                 slotted[req.guid] = req
                 placed -= 1

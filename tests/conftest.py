@@ -31,7 +31,7 @@ import pytest  # noqa: E402
 _EARLY_FILES = ("test_loadgen.py", "test_telemetry.py",
                 "test_spec_controller.py", "test_overload.py",
                 "test_fleet.py", "test_observability.py",
-                "test_prefix_cache.py")
+                "test_prefix_cache.py", "test_seq_parallel.py")
 
 
 def pytest_collection_modifyitems(session, config, items):

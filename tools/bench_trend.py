@@ -130,6 +130,15 @@ FLOOR_GROUPS: Dict[str, Dict[str, float]] = {
         "serving_prefix.knee_ratio": 1.05,
         "serving_prefix.prefix_saved_frac": 0.25,
     },
+    # ISSUE 20: on the 32k-token batch-1 PCG the mesh-factorization search
+    # must SELECT a sequence-sharded plan (seq_degree >= 2 — DP cannot
+    # split one request) and its analytic cost must beat the DP-degenerate
+    # replicated placement (speedup >= 1.0; both deterministic cost-model
+    # quantities, so the floors are tight).
+    "long_context": {
+        "long_context.seq_vs_dp_speedup": 1.0,
+        "long_context.seq_degree": 2.0,
+    },
 }
 
 # flattened legacy view (kept: external callers/tests address it)

@@ -1,0 +1,213 @@
+#!/usr/bin/env python3
+"""Regenerate the packaged default substitution vocabulary.
+
+Writes ``flexflow_tpu/search/substitutions/graph_subst_default.json`` in the
+reference rule schema (``graph_subst_3_v2.json``; loader:
+flexflow_tpu/search/substitution.py:load_rules_json), so the full JSON
+vocabulary — not the 5 builtins — can be the default search space of
+``optimize_model``.
+
+The families are generated, not hand-listed, mirroring how the reference's
+640-rule file is TASO-generated rather than curated:
+
+* producer→activation(-chain) collapses: the cost model sees the one fused
+  kernel XLA actually emits (LINEAR/CONV2D/BATCHMATMUL/EMBEDDING/ATTENTION
+  followed by 1-3 elementwise unaries);
+* elementwise-chain and binary-op collapses (same argument);
+* concat/elementwise commutes (sound: elementwise ops distribute over
+  concat), binary commutativity;
+* binary reassociations and transpose/reshape merges — faithful to the
+  reference vocabulary even where our apply() conservatively refuses them
+  (ambiguous fused-weight or proto bindings return None at apply time, so
+  they cost match attempts only).
+
+Rules here only change what the COST MODEL reasons about: a winning rewrite
+maps back onto the original layers via node ``covers`` (expand_strategy), so
+an over-eager collapse can mis-cost but never mis-execute.
+"""
+
+import json
+import os
+
+# (type, arity) — arity must match the concrete node's input-slot count or
+# find_matches rejects the binding
+PRODUCERS = [("OP_LINEAR", 1), ("OP_CONV2D", 1), ("OP_EMBEDDING", 1),
+             ("OP_BATCHMATMUL", 2), ("OP_MULTIHEAD_ATTENTION", 3)]
+CHAIN_PRODUCERS = [("OP_LINEAR", 1), ("OP_CONV2D", 1), ("OP_BATCHMATMUL", 2)]
+UNARIES = ["OP_RELU", "OP_SIGMOID", "OP_TANH", "OP_SOFTMAX", "OP_DROPOUT"]
+# elementwise unaries that distribute over concat (softmax does not)
+EW_UNARIES = ["OP_RELU", "OP_SIGMOID", "OP_TANH", "OP_DROPOUT"]
+BINARIES = ["OP_EW_ADD", "OP_EW_MUL"]
+
+
+def ext(i, ts=0):
+    return {"opId": -i, "tsId": ts}
+
+
+def inp(op, ts=0):
+    return {"opId": op, "tsId": ts}
+
+
+def op(t, inputs):
+    return {"type": t, "input": inputs}
+
+
+def mapped(dst_op, src_op, dst_ts=0, src_ts=0):
+    return {"dstOpId": dst_op, "dstTsId": dst_ts,
+            "srcOpId": src_op, "srcTsId": src_ts}
+
+
+def rule(name, src, dst, mapped_outputs):
+    return {"name": name, "srcOp": src, "dstOp": dst,
+            "mappedOutput": mapped_outputs}
+
+
+def short(t):
+    return t[3:].lower()
+
+
+def producer_pattern(t, arity, op_idx_base=0):
+    """A producer OpX consuming `arity` distinct externals."""
+    return op(t, [ext(i + 1) for i in range(arity)])
+
+
+def main():
+    rules = []
+
+    # A: producer → unary  =>  producer (XLA fuses the epilogue)
+    for p, ar in PRODUCERS:
+        for u in UNARIES:
+            rules.append(rule(
+                f"collapse_{short(p)}_{short(u)}",
+                [producer_pattern(p, ar), op(u, [inp(0)])],
+                [producer_pattern(p, ar)],
+                [mapped(0, 1)]))
+
+    # B: producer → unary → unary  =>  producer
+    for p, ar in PRODUCERS:
+        for u1 in UNARIES:
+            for u2 in UNARIES:
+                rules.append(rule(
+                    f"collapse_{short(p)}_{short(u1)}_{short(u2)}",
+                    [producer_pattern(p, ar), op(u1, [inp(0)]),
+                     op(u2, [inp(1)])],
+                    [producer_pattern(p, ar)],
+                    [mapped(0, 2)]))
+
+    # G: producer → unary → unary → unary  =>  producer
+    for p, ar in CHAIN_PRODUCERS:
+        for u1 in UNARIES:
+            for u2 in UNARIES:
+                for u3 in UNARIES:
+                    rules.append(rule(
+                        "collapse_{}_{}_{}_{}".format(
+                            short(p), short(u1), short(u2), short(u3)),
+                        [producer_pattern(p, ar), op(u1, [inp(0)]),
+                         op(u2, [inp(1)]), op(u3, [inp(2)])],
+                        [producer_pattern(p, ar)],
+                        [mapped(0, 3)]))
+
+    # C: unary → unary  =>  unary (one fused elementwise kernel)
+    for u1 in UNARIES:
+        for u2 in UNARIES:
+            rules.append(rule(
+                f"collapse_{short(u1)}_{short(u2)}",
+                [op(u1, [ext(1)]), op(u2, [inp(0)])],
+                [op(u1, [ext(1)])],
+                [mapped(0, 1)]))
+
+    # P: unary → unary → unary  =>  unary
+    for u1 in UNARIES:
+        for u2 in UNARIES:
+            for u3 in UNARIES:
+                rules.append(rule(
+                    f"collapse_{short(u1)}_{short(u2)}_{short(u3)}",
+                    [op(u1, [ext(1)]), op(u2, [inp(0)]), op(u3, [inp(1)])],
+                    [op(u1, [ext(1)])],
+                    [mapped(0, 2)]))
+
+    # D: binary → unary  =>  binary
+    for b in BINARIES:
+        for u in UNARIES:
+            rules.append(rule(
+                f"collapse_{short(b)}_{short(u)}",
+                [op(b, [ext(1), ext(2)]), op(u, [inp(0)])],
+                [op(b, [ext(1), ext(2)])],
+                [mapped(0, 1)]))
+
+    # L: unary feeding one operand of a binary  =>  binary
+    for b in BINARIES:
+        for u in UNARIES:
+            rules.append(rule(
+                f"collapse_{short(u)}_into_{short(b)}_lhs",
+                [op(u, [ext(1)]), op(b, [inp(0), ext(2)])],
+                [op(b, [ext(1), ext(2)])],
+                [mapped(0, 1)]))
+            rules.append(rule(
+                f"collapse_{short(u)}_into_{short(b)}_rhs",
+                [op(u, [ext(1)]), op(b, [ext(2), inp(0)])],
+                [op(b, [ext(2), ext(1)])],
+                [mapped(0, 1)]))
+
+    # E: binary commutativity
+    for b in BINARIES:
+        rules.append(rule(
+            f"commute_{short(b)}",
+            [op(b, [ext(1), ext(2)])],
+            [op(b, [ext(2), ext(1)])],
+            [mapped(0, 0)]))
+
+    # F: binary reassociation, both directions (vocabulary-faithful; our
+    # apply() refuses the ambiguous proto binding, so these are match-only)
+    for b in BINARIES:
+        rules.append(rule(
+            f"assoc_{short(b)}_l2r",
+            [op(b, [ext(1), ext(2)]), op(b, [inp(0), ext(3)])],
+            [op(b, [ext(2), ext(3)]), op(b, [ext(1), inp(0)])],
+            [mapped(1, 1)]))
+        rules.append(rule(
+            f"assoc_{short(b)}_r2l",
+            [op(b, [ext(2), ext(3)]), op(b, [ext(1), inp(0)])],
+            [op(b, [ext(1), ext(2)]), op(b, [inp(0), ext(3)])],
+            [mapped(1, 1)]))
+
+    # H: elementwise-unary / concat commutes (sound both ways)
+    for u in EW_UNARIES:
+        rules.append(rule(
+            f"commute_{short(u)}_over_concat",
+            [op("OP_CONCAT", [ext(1), ext(2)]), op(u, [inp(0)])],
+            [op(u, [ext(1)]), op(u, [ext(2)]), op("OP_CONCAT",
+                                                  [inp(0), inp(1)])],
+            [mapped(2, 1)]))
+        rules.append(rule(
+            f"commute_concat_over_{short(u)}",
+            [op(u, [ext(1)]), op(u, [ext(2)]),
+             op("OP_CONCAT", [inp(0), inp(1)])],
+            [op("OP_CONCAT", [ext(1), ext(2)]), op(u, [inp(0)])],
+            [mapped(1, 2)]))
+
+    # I: transpose/reshape merges
+    rules.append(rule(
+        "merge_transpose_transpose",
+        [op("OP_TRANSPOSE", [ext(1)]), op("OP_TRANSPOSE", [inp(0)])],
+        [op("OP_TRANSPOSE", [ext(1)])],
+        [mapped(0, 1)]))
+    rules.append(rule(
+        "merge_reshape_reshape",
+        [op("OP_RESHAPE", [ext(1)]), op("OP_RESHAPE", [inp(0)])],
+        [op("OP_RESHAPE", [ext(1)])],
+        [mapped(0, 1)]))
+
+    out_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "flexflow_tpu", "search", "substitutions",
+        "graph_subst_default.json")
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump({"rule": rules}, f, indent=1)
+        f.write("\n")
+    print(f"wrote {len(rules)} rules to {out_path}")
+
+
+if __name__ == "__main__":
+    main()
